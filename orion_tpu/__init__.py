@@ -1,0 +1,22 @@
+"""orion-tpu: a TPU-native linear-attention transformer framework.
+
+A ground-up JAX/XLA/Pallas implementation of the capabilities of
+`angeloskath/orion` (reference spec: /root/repo/BASELINE.json north_star —
+the reference checkout itself was never mounted, see SURVEY.md §0):
+
+- causal linear attention in its three equivalent forms (parallel O(T^2)
+  eager reference, chunked kv-cumsum recurrence for training, O(1)-state
+  recurrent form for decoding), with Pallas TPU kernels behind a
+  ``backend=`` dispatch,
+- softmax and sliding-window attention (flash-style Pallas kernels) for the
+  LRA configs and the hybrid model family,
+- ``train`` / ``generate`` entrypoints,
+- data/fsdp/tensor/sequence parallelism over a `jax.sharding.Mesh` with XLA
+  collectives over ICI/DCN (replacing the reference's NCCL wrapper).
+"""
+
+__version__ = "0.1.0"
+
+from orion_tpu import ops
+
+__all__ = ["ops", "__version__"]

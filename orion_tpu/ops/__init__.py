@@ -1,0 +1,32 @@
+"""Compute ops: attention kernels, feature maps, rotary embeddings.
+
+Layout:
+- ``feature_maps``: kernel feature maps phi(.) for linear attention.
+- ``linear_attention``: causal/non-causal linear attention in eager,
+  chunked, and recurrent forms (pure XLA).
+- ``pallas``: TPU Pallas kernels (causal_dot_product, flash attention).
+- ``softmax_attention``: exact softmax attention (full + sliding window).
+- ``dispatch``: backend="xla"|"pallas"|"auto" selection.
+"""
+
+from orion_tpu.ops.feature_maps import make_feature_map
+from orion_tpu.ops.linear_attention import (
+    causal_dot_product_eager,
+    causal_dot_product_chunked,
+    kv_state,
+    linear_attention,
+    linear_attention_noncausal,
+    recurrent_step,
+)
+from orion_tpu.ops.dispatch import causal_dot_product
+
+__all__ = [
+    "make_feature_map",
+    "causal_dot_product",
+    "causal_dot_product_eager",
+    "causal_dot_product_chunked",
+    "kv_state",
+    "linear_attention",
+    "linear_attention_noncausal",
+    "recurrent_step",
+]

@@ -1,0 +1,280 @@
+"""Causal linear attention — pure-XLA implementations of all three forms.
+
+The reference exposes a CUDA kernel ``causal_dot_product`` computing
+
+    out[t] = sum_{s <= t} (q_t . k_s) v_s
+
+plus a chunked "kv-cumsum" recurrence and an O(1)-state recurrent decode
+step (BASELINE.json north_star; the reference checkout was never mounted —
+SURVEY.md §0). This module provides the same three mathematically equivalent
+forms as pure-XLA JAX:
+
+1. ``causal_dot_product_eager``   — materializes the T×T matrix. O(T^2)
+   memory; the CPU-parity reference implementation ("CPU eager ref" config).
+2. ``causal_dot_product_chunked`` — chunked recurrence: intra-chunk term via
+   masked C×C matmuls (MXU), inter-chunk term via a carried state
+   S = cumsum(k ⊗ v). O(T·C) memory, O(T·C·D) time. This is the training
+   form; the Pallas kernel in ``ops/pallas/causal_dot.py`` is its
+   hand-scheduled twin.
+3. ``recurrent_step``             — single-token update S += k⊗v, z += k,
+   used by the constant-memory decode path.
+
+Conventions: q, k are post-feature-map ("phi space") with shape
+[..., T, Dk]; v is [..., T, Dv]. All accumulation is fp32 regardless of
+input dtype; outputs match the input dtype.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_DEFAULT_EPS = 1e-6
+
+
+def _f32(*xs):
+    return tuple(x.astype(jnp.float32) for x in xs)
+
+
+# ---------------------------------------------------------------------------
+# 1. Eager (quadratic) reference form
+# ---------------------------------------------------------------------------
+
+
+def causal_dot_product_eager(q: Array, k: Array, v: Array) -> Array:
+    """out[t] = sum_{s<=t} (q_t . k_s) v_s, materializing the T×T scores.
+
+    The parity reference for every other path. fp32 throughout.
+    """
+    qf, kf, vf = _f32(q, k, v)
+    scores = jnp.einsum("...td,...sd->...ts", qf, kf)
+    t = q.shape[-2]
+    mask = jnp.tril(jnp.ones((t, t), dtype=jnp.float32))
+    out = jnp.einsum("...ts,...sd->...td", scores * mask, vf)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# 2. Chunked (kv-cumsum) training form
+# ---------------------------------------------------------------------------
+
+
+def _pad_chunks(x: Array, chunk: int) -> Tuple[Array, int]:
+    t = x.shape[-2]
+    rem = (-t) % chunk
+    if rem:
+        pad = [(0, 0)] * (x.ndim - 2) + [(0, rem), (0, 0)]
+        x = jnp.pad(x, pad)
+    return x, t
+
+
+@partial(jax.jit, static_argnames=("chunk", "return_state"))
+def causal_dot_product_chunked(
+    q: Array,
+    k: Array,
+    v: Array,
+    chunk: int = 128,
+    return_state: bool = False,
+    initial_state: Optional[Array] = None,
+):
+    """Chunked causal dot product via lax.scan over sequence chunks.
+
+    Per chunk c (size C): with carried state S = sum_{s < c·C} k_s ⊗ v_s,
+        intra = (Q_c K_c^T ⊙ M) V_c      (M = causal mask, s <= t)
+        inter = Q_c S
+        S    += K_c^T V_c
+    Both terms are dense matmuls that tile onto the MXU; the scan carries
+    only the [Dk, Dv] state. Equivalent to the eager form exactly (fp32).
+
+    If ``return_state``, also returns the final state S (for prefill →
+    recurrent decode handoff). ``initial_state`` seeds S (default zeros).
+    """
+    orig_dtype = q.dtype
+    qf, kf, vf = _f32(q, k, v)
+    qf, t = _pad_chunks(qf, chunk)
+    kf, _ = _pad_chunks(kf, chunk)
+    vf, _ = _pad_chunks(vf, chunk)
+
+    batch_shape = qf.shape[:-2]
+    n = qf.shape[-2] // chunk
+    dk, dv = qf.shape[-1], vf.shape[-1]
+
+    # [..., n, C, d] -> [n, ..., C, d] so scan's leading axis is chunks.
+    def to_chunks(x, d):
+        x = x.reshape(*batch_shape, n, chunk, d)
+        return jnp.moveaxis(x, -3, 0)
+
+    qc, kc, vc = to_chunks(qf, dk), to_chunks(kf, dk), to_chunks(vf, dv)
+
+    mask = jnp.tril(jnp.ones((chunk, chunk), dtype=jnp.float32))
+    if initial_state is None:
+        s0 = jnp.zeros((*batch_shape, dk, dv), dtype=jnp.float32)
+    else:
+        s0 = initial_state.astype(jnp.float32)
+
+    def body(s, qkv):
+        qi, ki, vi = qkv
+        scores = jnp.einsum("...td,...sd->...ts", qi, ki) * mask
+        intra = jnp.einsum("...ts,...sd->...td", scores, vi)
+        inter = jnp.einsum("...td,...de->...te", qi, s)
+        s_new = s + jnp.einsum("...td,...te->...de", ki, vi)
+        return s_new, intra + inter
+
+    s_final, out = jax.lax.scan(body, s0, (qc, kc, vc))
+    out = jnp.moveaxis(out, 0, -3).reshape(*batch_shape, n * chunk, dv)
+    out = out[..., :t, :].astype(orig_dtype)
+    if return_state:
+        return out, s_final  # state stays fp32 for the decode handoff
+    return out
+
+
+def kv_state(
+    k: Array,
+    v: Array,
+    initial_state: Optional[Tuple[Array, Array]] = None,
+) -> Tuple[Array, Array]:
+    """Final kv-cumsum state (S = sum_s k_s ⊗ v_s, z = sum_s k_s).
+
+    The "kv-cumsum" reduction the reference ships as a CUDA kernel; on TPU
+    these are two einsum reductions XLA fuses. Used to initialize the
+    recurrent decode state from a processed prompt.
+    """
+    kf, vf = _f32(k, v)
+    s = jnp.einsum("...td,...te->...de", kf, vf)
+    z = jnp.sum(kf, axis=-2)
+    if initial_state is not None:
+        s0, z0 = initial_state
+        s = s + s0.astype(jnp.float32)
+        z = z + z0.astype(jnp.float32)
+    return s, z  # fp32, matching the decode-state convention
+
+
+# ---------------------------------------------------------------------------
+# 3. Recurrent (O(1)-state) decode form
+# ---------------------------------------------------------------------------
+
+
+def recurrent_step(
+    q: Array,
+    k: Array,
+    v: Array,
+    state: Tuple[Array, Array],
+    eps: float = _DEFAULT_EPS,
+) -> Tuple[Array, Tuple[Array, Array]]:
+    """One decode step: S += k ⊗ v, z += k, out = (q·S) / (q·z + eps).
+
+    q, k: [..., Dk]; v: [..., Dv]; state = (S [..., Dk, Dv], z [..., Dk]).
+    State is carried in fp32. The normalized output equals row t of
+    ``linear_attention`` run over the full prefix — the decisive invariant
+    tested in tests/test_linear_attention.py.
+    """
+    s, z = state
+    qf, kf, vf = _f32(q, k, v)
+    sf, zf = s.astype(jnp.float32), z.astype(jnp.float32)
+    sf = sf + kf[..., :, None] * vf[..., None, :]
+    zf = zf + kf
+    num = jnp.einsum("...d,...de->...e", qf, sf)
+    den = jnp.einsum("...d,...d->...", qf, zf)[..., None] + eps
+    out = (num / den).astype(q.dtype)
+    return out, (sf, zf)
+
+
+def init_recurrent_state(batch_shape, dk: int, dv: int) -> Tuple[Array, Array]:
+    """Zero decode state (S, z) in fp32."""
+    return (
+        jnp.zeros((*batch_shape, dk, dv), dtype=jnp.float32),
+        jnp.zeros((*batch_shape, dk), dtype=jnp.float32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Normalized linear attention (what models call)
+# ---------------------------------------------------------------------------
+
+
+def linear_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    backend: str = "auto",
+    chunk: int = 128,
+    eps: float = _DEFAULT_EPS,
+    initial_state: Optional[Tuple[Array, Array]] = None,
+    return_state: bool = False,
+):
+    """Normalized causal linear attention over feature-mapped q, k.
+
+    out[t] = (q_t · S_t) / (q_t · z_t + eps),  S_t = Σ_{s<=t} k_s⊗v_s,
+    z_t = Σ_{s<=t} k_s. The numerator goes through ``causal_dot_product``
+    (dispatched to Pallas or XLA by ``backend``); the normalizer is a
+    cumulative sum XLA handles well on its own.
+    """
+    from orion_tpu.ops.dispatch import causal_dot_product  # cycle-free import
+
+    s0 = z0 = None
+    if initial_state is not None:
+        s0, z0 = initial_state
+
+    if return_state or s0 is not None:
+        num, s_final = causal_dot_product(
+            q, k, v, backend=backend, chunk=chunk, return_state=True,
+            initial_state=s0,
+        )
+    else:
+        num = causal_dot_product(q, k, v, backend=backend, chunk=chunk)
+        s_final = None
+
+    kf = k.astype(jnp.float32)
+    zcum = jnp.cumsum(kf, axis=-2)
+    if z0 is not None:
+        zcum = zcum + z0.astype(jnp.float32)[..., None, :]
+    den = jnp.einsum("...td,...td->...t", q.astype(jnp.float32), zcum)
+    out = (num.astype(jnp.float32) / (den[..., None] + eps)).astype(q.dtype)
+
+    if return_state:
+        z_final = zcum[..., -1, :]
+        return out, (s_final.astype(jnp.float32), z_final)
+    return out
+
+
+def linear_attention_noncausal(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    eps: float = _DEFAULT_EPS,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Bidirectional (non-causal) linear attention, for encoder/LRA models.
+
+    out = phi(Q) (phi(K)^T V) / (phi(Q) · Σ_s phi(k_s)). With an optional
+    boolean padding mask [..., T] applied to keys. O(T·D^2): the whole point
+    of linear attention on LRA-length sequences.
+    """
+    qf, kf, vf = _f32(q, k, v)
+    if mask is not None:
+        m = mask.astype(jnp.float32)[..., None]
+        kf = kf * m
+        vf = vf * m
+    kv = jnp.einsum("...td,...te->...de", kf, vf)
+    z = jnp.sum(kf, axis=-2)
+    num = jnp.einsum("...td,...de->...te", qf, kv)
+    den = jnp.einsum("...td,...d->...t", qf, z)[..., None] + eps
+    return (num / den).astype(q.dtype)
+
+
+__all__ = [
+    "causal_dot_product_eager",
+    "causal_dot_product_chunked",
+    "kv_state",
+    "recurrent_step",
+    "init_recurrent_state",
+    "linear_attention",
+    "linear_attention_noncausal",
+]

@@ -1,0 +1,46 @@
+"""Rotary position embeddings (RoPE) for the softmax/sliding-window layers.
+
+Linear-attention layers use learned absolute positions (rotating phi-space
+vectors breaks the kernel trick); the softmax and sliding-window layers of
+the hybrid model family use RoPE. Supports an offset for decode-time single
+positions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def rotary_freqs(head_dim: int, max_t: int, base: float = 10000.0) -> Array:
+    """[max_t, head_dim//2] angle table."""
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_t, dtype=jnp.float32)
+    return jnp.outer(t, inv)  # [T, D/2]
+
+
+def apply_rotary(x: Array, angles: Array) -> Array:
+    """Rotate pairs. x: [..., T, D]; angles: [T, D/2] (or broadcastable)."""
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def apply_rotary_at(x: Array, angles_table: Array, positions: Array) -> Array:
+    """Decode-time: x [..., D] at integer positions [...]. Gathers angles."""
+    ang = angles_table[positions]  # [..., D/2]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+__all__ = ["rotary_freqs", "apply_rotary", "apply_rotary_at"]

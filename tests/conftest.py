@@ -1,0 +1,22 @@
+"""Test config: force CPU with 8 virtual devices so sharding/SP/ring tests
+run without TPU hardware (the TPU-world analogue of testing a NCCL codebase
+on gloo/fake process groups). Must run before jax is imported anywhere."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # never run unit tests on TPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize registers a TPU PJRT plugin and pins
+# jax_platforms before user code runs; the env var alone doesn't win.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+assert jax.default_backend() == "cpu", jax.devices()
+assert jax.device_count() >= 8, jax.devices()

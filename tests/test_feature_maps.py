@@ -1,0 +1,49 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.ops.feature_maps import make_feature_map
+
+
+@pytest.mark.parametrize("name", ["elu1", "relu", "sqrelu", "exp", "identity"])
+def test_simple_maps_shapes_and_positivity(name):
+    fm = make_feature_map(name)
+    x = jax.random.normal(jax.random.key(0), (2, 3, 16, 32))
+    y = fm(x)
+    assert y.shape == x.shape
+    assert jnp.all(jnp.isfinite(y))
+    if name in ("elu1", "relu", "sqrelu", "exp"):
+        assert jnp.all(y >= 0)
+    if name == "elu1":
+        assert jnp.all(y > 0)  # strictly positive -> safe normalizer
+
+
+def test_favor_approximates_softmax_kernel():
+    d, m = 32, 512
+    fm = make_feature_map("favor", key=jax.random.key(1), dim=d, num_features=m)
+    q = jax.random.normal(jax.random.key(2), (64, d)) * 0.5
+    k = jax.random.normal(jax.random.key(3), (64, d)) * 0.5
+    phi_q, phi_k = fm(q), fm(k)
+    assert phi_q.shape == (64, m)
+    # FAVOR's per-vector stabilizer rescales rows, so compare the *normalized*
+    # attention distributions, which is what the model actually uses.
+    approx = phi_q @ phi_k.T
+    approx = approx / approx.sum(-1, keepdims=True)
+    exact = jax.nn.softmax(q @ k.T / jnp.sqrt(d), axis=-1)
+    err = jnp.abs(approx - exact).max()
+    assert err < 0.08, f"FAVOR+ attention deviates from softmax: {err}"
+
+
+def test_favor_grads_finite():
+    fm = make_feature_map("favor", key=jax.random.key(0), dim=16)
+    x = jax.random.normal(jax.random.key(1), (8, 16))
+    g = jax.grad(lambda x: jnp.sum(fm(x) ** 2))(x)
+    assert jnp.all(jnp.isfinite(g))
+
+
+def test_unknown_name_raises():
+    with pytest.raises(ValueError):
+        make_feature_map("nope")
+    with pytest.raises(ValueError):
+        make_feature_map("favor")  # missing key/dim

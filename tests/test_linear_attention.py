@@ -1,0 +1,170 @@
+"""Parity tests across the three forms of causal linear attention.
+
+The decisive invariants of any causal_dot_product implementation:
+  eager O(T^2) == chunked kv-cumsum == recurrent O(1)-state, and the
+  normalized outputs of each match row-for-row.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.ops import (
+    causal_dot_product_chunked,
+    causal_dot_product_eager,
+    kv_state,
+    linear_attention,
+    linear_attention_noncausal,
+    recurrent_step,
+)
+from orion_tpu.ops.linear_attention import init_recurrent_state
+from orion_tpu.ops.feature_maps import make_feature_map
+
+
+def _qkv(key, b=2, h=3, t=67, dk=16, dv=24, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    fm = make_feature_map("elu1")
+    q = fm(jax.random.normal(k1, (b, h, t, dk), dtype=dtype))
+    k = fm(jax.random.normal(k2, (b, h, t, dk), dtype=dtype))
+    v = jax.random.normal(k3, (b, h, t, dv), dtype=dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("chunk", [16, 32, 128])
+def test_chunked_matches_eager(chunk):
+    q, k, v = _qkv(jax.random.key(0))
+    ref = causal_dot_product_eager(q, k, v)
+    out = causal_dot_product_chunked(q, k, v, chunk=chunk)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-3)
+
+
+def test_chunked_final_state_matches_kv_state():
+    q, k, v = _qkv(jax.random.key(1), t=64)
+    _, s = causal_dot_product_chunked(q, k, v, chunk=16, return_state=True)
+    s_ref, _ = kv_state(k, v)
+    np.testing.assert_allclose(s, s_ref, rtol=1e-4, atol=1e-3)
+
+
+def test_chunked_initial_state_continuation():
+    """Splitting a sequence in two and carrying S must equal one pass."""
+    q, k, v = _qkv(jax.random.key(2), t=80)
+    ref = causal_dot_product_eager(q, k, v)
+    out1, s1 = causal_dot_product_chunked(
+        q[..., :48, :], k[..., :48, :], v[..., :48, :], chunk=16, return_state=True
+    )
+    out2 = causal_dot_product_chunked(
+        q[..., 48:, :], k[..., 48:, :], v[..., 48:, :], chunk=16, initial_state=s1
+    )
+    np.testing.assert_allclose(
+        jnp.concatenate([out1, out2], axis=-2), ref, rtol=1e-4, atol=1e-3
+    )
+
+
+def test_recurrent_matches_parallel_normalized():
+    q, k, v = _qkv(jax.random.key(3), b=1, h=2, t=33)
+    ref = linear_attention(q, k, v, backend="xla", chunk=16)
+
+    s, z = init_recurrent_state(q.shape[:-2], q.shape[-1], v.shape[-1])
+    outs = []
+    for t in range(q.shape[-2]):
+        o, (s, z) = recurrent_step(q[..., t, :], k[..., t, :], v[..., t, :], (s, z))
+        outs.append(o)
+    rec = jnp.stack(outs, axis=-2)
+    np.testing.assert_allclose(rec, ref, rtol=2e-4, atol=2e-3)
+
+
+def test_linear_attention_state_handoff():
+    """Prefill (parallel) then continue recurrently == full parallel pass."""
+    q, k, v = _qkv(jax.random.key(4), b=1, h=1, t=40)
+    ref = linear_attention(q, k, v, backend="xla", chunk=8)
+
+    prefix = 32
+    out_p, (s, z) = linear_attention(
+        q[..., :prefix, :], k[..., :prefix, :], v[..., :prefix, :],
+        backend="xla", chunk=8, return_state=True,
+    )
+    np.testing.assert_allclose(out_p, ref[..., :prefix, :], rtol=1e-4, atol=1e-3)
+    outs = []
+    for t in range(prefix, q.shape[-2]):
+        o, (s, z) = recurrent_step(q[..., t, :], k[..., t, :], v[..., t, :], (s, z))
+        outs.append(o)
+    rec = jnp.stack(outs, axis=-2)
+    np.testing.assert_allclose(rec, ref[..., prefix:, :], rtol=2e-4, atol=2e-3)
+
+
+def test_bf16_inputs_fp32_accumulation():
+    q, k, v = _qkv(jax.random.key(5), t=128, dtype=jnp.bfloat16)
+    ref = causal_dot_product_eager(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    out = causal_dot_product_chunked(q, k, v, chunk=32)
+    assert out.dtype == jnp.bfloat16
+    # bf16 inputs, fp32 accumulation: error bounded by input quantization.
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref, rtol=5e-2, atol=5e-2
+    )
+
+
+def test_grads_match_eager():
+    q, k, v = _qkv(jax.random.key(6), b=1, h=2, t=48)
+
+    def loss(fn):
+        def f(q, k, v):
+            return jnp.sum(fn(q, k, v) ** 2)
+        return f
+
+    ge = jax.grad(loss(causal_dot_product_eager), argnums=(0, 1, 2))(q, k, v)
+    gc = jax.grad(loss(lambda *a: causal_dot_product_chunked(*a, chunk=16)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(ge, gc):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-3)
+
+
+def test_noncausal_matches_masked_dense():
+    fm = make_feature_map("elu1")
+    kq, kk, kv_, km = jax.random.split(jax.random.key(7), 4)
+    q = fm(jax.random.normal(kq, (2, 2, 50, 16)))
+    k = fm(jax.random.normal(kk, (2, 2, 50, 16)))
+    v = jax.random.normal(kv_, (2, 2, 50, 8))
+    mask = jax.random.bernoulli(km, 0.8, (2, 2, 50))
+
+    out = linear_attention_noncausal(q, k, v, mask=mask)
+    scores = jnp.einsum("...td,...sd->...ts", q, k) * mask[..., None, :]
+    ref = jnp.einsum("...ts,...se->...te", scores, v * mask[..., None]) / (
+        scores.sum(-1, keepdims=True) + 1e-6
+    )
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-3)
+
+
+def test_rotary_roundtrip_norm_preserving():
+    from orion_tpu.ops.rotary import apply_rotary, apply_rotary_at, rotary_freqs
+
+    x = jax.random.normal(jax.random.key(8), (2, 4, 10, 32))
+    ang = rotary_freqs(32, 10)
+    y = apply_rotary(x, ang)
+    np.testing.assert_allclose(
+        jnp.linalg.norm(y, axis=-1), jnp.linalg.norm(x, axis=-1), rtol=1e-5
+    )
+    # single-position gather path matches the batch path
+    y_at = apply_rotary_at(x[:, :, 7, :], ang, jnp.array(7))
+    np.testing.assert_allclose(y_at, y[:, :, 7, :], rtol=1e-5, atol=1e-6)
+
+
+def test_kv_state_handoff_stays_fp32():
+    """kv_state prefill -> recurrent decode must match the parallel path,
+    i.e. the handed-off state must not be quantized to the input dtype."""
+    q, k, v = _qkv(jax.random.key(9), b=1, h=1, t=24, dtype=jnp.bfloat16)
+    ref = linear_attention(q, k, v, backend="xla", chunk=8)
+
+    prefix = 16
+    s, z = kv_state(k[..., :prefix, :], v[..., :prefix, :])
+    assert s.dtype == jnp.float32 and z.dtype == jnp.float32
+    outs = []
+    for t in range(prefix, q.shape[-2]):
+        o, (s, z) = recurrent_step(q[..., t, :], k[..., t, :], v[..., t, :], (s, z))
+        outs.append(o)
+    rec = jnp.stack(outs, axis=-2).astype(jnp.float32)
+    np.testing.assert_allclose(
+        rec, ref[..., prefix:, :].astype(jnp.float32), rtol=5e-2, atol=5e-2
+    )
