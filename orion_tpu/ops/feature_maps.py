@@ -11,7 +11,7 @@ Provided maps:
 - ``elu1``   : x -> elu(x) + 1              (default; "Transformers are RNNs")
 - ``relu``   : x -> max(x, 0)
 - ``sqrelu`` : x -> max(x, 0)^2
-- ``exp``    : x -> exp(x - max(x))         (per-vector stabilized)
+- ``exp``    : x -> exp(x)                  (fp32; no data-dependent shift)
 - ``favor``  : FAVOR+ positive random features approximating the softmax
                kernel (Performer), with an orthogonal random projection.
 - ``identity``
@@ -56,8 +56,13 @@ def _sqrelu(x):
     return r * r
 
 
-def _exp_stable(x):
-    return jnp.exp(x - jax.lax.stop_gradient(jnp.max(x, axis=-1, keepdims=True)))
+def _exp(x):
+    # Plain exp in fp32. No data-dependent stabilizer: phi must be a *fixed*
+    # function — a per-vector or per-batch shift would rescale keys against
+    # each other (biasing attention) and make prefill-phi differ from
+    # decode-phi. exp of a normalized-head-vector coordinate is safely
+    # within fp32 range.
+    return jnp.exp(x.astype(jnp.float32)).astype(x.dtype)
 
 
 def _orthogonal_gaussian(key: jax.Array, rows: int, cols: int) -> jax.Array:
@@ -86,7 +91,10 @@ def _orthogonal_gaussian(key: jax.Array, rows: int, cols: int) -> jax.Array:
 
 
 def favor_features(
-    key: jax.Array, dim: int, num_features: Optional[int] = None
+    key: jax.Array,
+    dim: int,
+    num_features: Optional[int] = None,
+    stabilizer: float = 0.0,
 ) -> FeatureMap:
     """FAVOR+ positive random features for the softmax kernel (Performer).
 
@@ -102,12 +110,13 @@ def favor_features(
         xf = x.astype(jnp.float32) / (dim**0.25)
         proj = jnp.einsum("...d,md->...m", xf, w)
         sq = 0.5 * jnp.sum(xf * xf, axis=-1, keepdims=True)
-        # Stabilize with a single global shift. A per-vector shift would be
-        # fine for queries (cancels in the normalizer) but NOT for keys: a
-        # per-key rescale reweights keys against each other and biases the
-        # attention estimate. One global constant cancels for both roles.
-        stab = jax.lax.stop_gradient(jnp.max(proj - sq))
-        return (jnp.exp(proj - sq - stab) / jnp.sqrt(m)).astype(x.dtype)
+        # ``stabilizer`` is a FIXED constant (default 0), not data-dependent:
+        # phi must be the same function at prefill and decode time, and a
+        # per-key rescale would reweight keys against each other and bias
+        # the attention estimate. The exponent proj - sq is bounded above by
+        # |w_i|^2/2 ~ d/2, within fp32 range for practical head dims; pass a
+        # positive ``stabilizer`` if working far outside that regime.
+        return (jnp.exp(proj - sq - stabilizer) / jnp.sqrt(m)).astype(x.dtype)
 
     return FeatureMap(name="favor", fn=fn, out_dim=m)
 
@@ -116,7 +125,7 @@ _SIMPLE = {
     "elu1": _elu1,
     "relu": _relu,
     "sqrelu": _sqrelu,
-    "exp": _exp_stable,
+    "exp": _exp,
     "identity": lambda x: x,
 }
 

@@ -221,13 +221,15 @@ def linear_attention(
     if initial_state is not None:
         s0, z0 = initial_state
 
-    if return_state or s0 is not None:
+    if return_state:
         num, s_final = causal_dot_product(
             q, k, v, backend=backend, chunk=chunk, return_state=True,
             initial_state=s0,
         )
     else:
-        num = causal_dot_product(q, k, v, backend=backend, chunk=chunk)
+        num = causal_dot_product(
+            q, k, v, backend=backend, chunk=chunk, initial_state=s0
+        )
         s_final = None
 
     kf = k.astype(jnp.float32)

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the hand-scheduled twins of the XLA ops.
+
+- ``causal_dot``: chunked causal linear attention (causal_dot_product +
+  kv-cumsum state), replacing the reference's CUDA kernels.
+- ``flash_attention``: online-softmax attention, full-causal and
+  sliding-window.
+"""

@@ -1,0 +1,202 @@
+"""Pallas TPU kernel for the causal dot product (linear attention core).
+
+TPU-native replacement for the reference's CUDA ``causal_dot_product`` /
+kv-cumsum kernels (BASELINE.json north_star). Computes, per (batch·head):
+
+    out[t]  = sum_{s<=t} (q_t . k_s) v_s  (+ q_t @ S0 for a carried-in state)
+    S_final = S0 + sum_s k_s (x) v_s
+
+Design (chunked kv-cumsum recurrence mapped onto the TPU):
+- grid = (B*H, T/C) with the chunk axis innermost: TPU grids execute
+  sequentially on a core, so a VMEM scratch accumulator carries the running
+  [Dk, Dv] state S across chunk steps — the Pallas analogue of the CUDA
+  kernel's shared-memory running state. S resets from S0 at chunk 0 of each
+  (batch·head) program.
+- per chunk, three MXU matmuls: scores = Q_c K_c^T (masked causally),
+  intra = scores @ V_c, inter = Q_c @ S; then S += K_c^T V_c.
+- all accumulation in fp32 regardless of input dtype (bf16 inputs hit the
+  MXU natively with ``preferred_element_type=float32``).
+
+The backward pass is the same kernel re-used: with g the output cotangent,
+    dq = cdp(g, v, k) + g @ S0^T
+    dk = rev(cdp(rev(v), rev(g), rev(q))) + v @ dSf^T
+    dv = rev(cdp(rev(k), rev(q), rev(g))) + k @ dSf
+    dS0 = sum_t q_t (x) g_t + dSf
+(rev = flip along time). Wired up via jax.custom_vjp so the op is fully
+differentiable, including through the carried state — which is what makes
+sequence-parallel training (parallel/sequence.py) differentiable too.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+
+def _kernel(q_ref, k_ref, v_ref, s0_ref, out_ref, sf_ref, s_scr):
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _():
+        s_scr[:] = s0_ref[0].astype(jnp.float32)
+
+    qi = q_ref[0]  # (C, Dk) input dtype
+    ki = k_ref[0]
+    vi = v_ref[0]
+
+    scores = jax.lax.dot_general(
+        qi,
+        ki,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (C, C) fp32
+    cdim = scores.shape[0]
+    row = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 1)
+    scores = jnp.where(row >= col, scores, 0.0)
+
+    intra = jnp.dot(scores, vi.astype(jnp.float32), preferred_element_type=jnp.float32)
+    inter = jnp.dot(
+        qi.astype(jnp.float32), s_scr[:], preferred_element_type=jnp.float32
+    )
+    out_ref[0] = (intra + inter).astype(out_ref.dtype)
+
+    s_scr[:] = s_scr[:] + jax.lax.dot_general(
+        ki,
+        vi,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    sf_ref[0] = s_scr[:]
+
+
+def _cdp_flat(
+    q: Array, k: Array, v: Array, s0: Array, chunk: int, interpret: bool
+) -> Tuple[Array, Array]:
+    """Unnormalized causal dot product on flat [BH, T, D] inputs (T % chunk == 0)."""
+    bh, t, dk = q.shape
+    dv = v.shape[-1]
+    nc = t // chunk
+
+    grid = (bh, nc)
+    out, sf = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, chunk, dk), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dk), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dv), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dk, dv), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, chunk, dv), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dk, dv), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, dv), q.dtype),
+            jax.ShapeDtypeStruct((bh, dk, dv), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((dk, dv), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * bh * t * (chunk * dk + chunk * dv + 2 * dk * dv),
+            bytes_accessed=q.size * q.dtype.itemsize * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(q, k, v, s0)
+    return out, sf
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _cdp(q, k, v, s0, chunk, interpret):
+    return _cdp_flat(q, k, v, s0, chunk, interpret)
+
+
+def _cdp_fwd(q, k, v, s0, chunk, interpret):
+    out, sf = _cdp_flat(q, k, v, s0, chunk, interpret)
+    return (out, sf), (q, k, v, s0)
+
+
+def _cdp_bwd(chunk, interpret, res, cts):
+    q, k, v, s0 = res
+    g, dsf = cts
+    g = g.astype(q.dtype)
+    dsf32 = dsf.astype(jnp.float32)
+    rev = lambda x: jnp.flip(x, axis=-2)  # noqa: E731
+    zkk = jnp.zeros((q.shape[0], v.shape[-1], q.shape[-1]), jnp.float32)  # for (g,v,k)
+    zvv = jnp.zeros((q.shape[0], v.shape[-1], q.shape[-1]), jnp.float32)
+    zqq = jnp.zeros((q.shape[0], q.shape[-1], v.shape[-1]), jnp.float32)
+
+    dq, _ = _cdp_flat(g, v, k, zkk, chunk, interpret)
+    dq = dq.astype(jnp.float32) + jnp.einsum(
+        "bte,bde->btd", g.astype(jnp.float32), s0.astype(jnp.float32)
+    )
+    dk, _ = _cdp_flat(rev(v), rev(g), rev(q), zvv, chunk, interpret)
+    dk = rev(dk).astype(jnp.float32) + jnp.einsum(
+        "bte,bde->btd", v.astype(jnp.float32), dsf32
+    )
+    dv, _ = _cdp_flat(rev(k), rev(q), rev(g), zqq, chunk, interpret)
+    dv = rev(dv).astype(jnp.float32) + jnp.einsum(
+        "btd,bde->bte", k.astype(jnp.float32), dsf32
+    )
+    ds0 = (
+        jnp.einsum("btd,bte->bde", q.astype(jnp.float32), g.astype(jnp.float32))
+        + dsf32
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), ds0
+
+
+_cdp.defvjp(_cdp_fwd, _cdp_bwd)
+
+
+def causal_dot_product_pallas(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    chunk: int = 128,
+    return_state: bool = False,
+    initial_state: Optional[Array] = None,
+    interpret: bool = False,
+):
+    """Public entry: arbitrary batch dims [..., T, Dk/Dv], auto pad/reshape.
+
+    Differentiable (custom VJP), including through ``initial_state`` and the
+    returned state. Zero-padding the tail chunk is safe: padded k/v rows
+    contribute nothing to S, and padded outputs are sliced off.
+    """
+    batch_shape = q.shape[:-2]
+    t, dk = q.shape[-2], q.shape[-1]
+    dv = v.shape[-1]
+    bh = 1
+    for s in batch_shape:
+        bh *= s
+
+    qf = q.reshape(bh, t, dk)
+    kf = k.reshape(bh, t, dk)
+    vf = v.reshape(bh, t, dv)
+    rem = (-t) % chunk
+    if rem:
+        pad = ((0, 0), (0, rem), (0, 0))
+        qf, kf, vf = jnp.pad(qf, pad), jnp.pad(kf, pad), jnp.pad(vf, pad)
+
+    if initial_state is None:
+        s0 = jnp.zeros((bh, dk, dv), jnp.float32)
+    else:
+        s0 = initial_state.astype(jnp.float32).reshape(bh, dk, dv)
+
+    out, sf = _cdp(qf, kf, vf, s0, chunk, interpret)
+    out = out[:, :t, :].reshape(*batch_shape, t, dv)
+    if return_state:
+        return out, sf.reshape(*batch_shape, dk, dv)
+    return out
+
+
+__all__ = ["causal_dot_product_pallas"]

@@ -21,26 +21,24 @@ def rotary_freqs(head_dim: int, max_t: int, base: float = 10000.0) -> Array:
     return jnp.outer(t, inv)  # [T, D/2]
 
 
-def apply_rotary(x: Array, angles: Array) -> Array:
-    """Rotate pairs. x: [..., T, D]; angles: [T, D/2] (or broadcastable)."""
-    xf = x.astype(jnp.float32)
-    x1, x2 = xf[..., 0::2], xf[..., 1::2]
-    cos, sin = jnp.cos(angles), jnp.sin(angles)
-    r1 = x1 * cos - x2 * sin
-    r2 = x1 * sin + x2 * cos
-    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
-    return out.astype(x.dtype)
-
-
-def apply_rotary_at(x: Array, angles_table: Array, positions: Array) -> Array:
-    """Decode-time: x [..., D] at integer positions [...]. Gathers angles."""
-    ang = angles_table[positions]  # [..., D/2]
+def _rotate(x: Array, ang: Array) -> Array:
+    """Shared pair-rotation body. ang broadcasts against x's leading dims."""
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., 0::2], xf[..., 1::2]
     cos, sin = jnp.cos(ang), jnp.sin(ang)
     r1 = x1 * cos - x2 * sin
     r2 = x1 * sin + x2 * cos
     return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def apply_rotary(x: Array, angles: Array) -> Array:
+    """Rotate pairs. x: [..., T, D]; angles: [T, D/2] (or broadcastable)."""
+    return _rotate(x, angles)
+
+
+def apply_rotary_at(x: Array, angles_table: Array, positions: Array) -> Array:
+    """Decode-time: x [..., D] at integer positions [...]. Gathers angles."""
+    return _rotate(x, angles_table[positions])
 
 
 __all__ = ["rotary_freqs", "apply_rotary", "apply_rotary_at"]
