@@ -19,8 +19,20 @@ from orion_tpu.ops.linear_attention import (
     recurrent_step,
 )
 from orion_tpu.ops.dispatch import causal_dot_product
+from orion_tpu.ops.softmax_attention import (
+    cached_attention,
+    softmax_attention,
+    softmax_attention_xla,
+)
+from orion_tpu.ops.rotary import apply_rotary, apply_rotary_at, rotary_freqs
 
 __all__ = [
+    "softmax_attention",
+    "softmax_attention_xla",
+    "cached_attention",
+    "apply_rotary",
+    "apply_rotary_at",
+    "rotary_freqs",
     "make_feature_map",
     "causal_dot_product",
     "causal_dot_product_eager",

@@ -1,0 +1,365 @@
+"""Pallas TPU flash attention: causal / bidirectional / sliding-window.
+
+TPU-native replacement for the reference's CUDA softmax-attention path
+(BASELINE.json north_star: LRA softmax configs and the 7B hybrid's
+sliding-window softmax layers; the reference checkout was never mounted —
+SURVEY.md §0). Online-softmax tiling: never materializes the T×T score
+matrix, accumulates in fp32 VMEM scratch.
+
+Forward:  grid (B·H, Tq/Bq, Tk/Bk), k-axis innermost (sequential on a TPU
+core), scratch carries the running row-max m, row-sum l, and output
+accumulator; finalized on the last k-block. Saves the log-sum-exp for the
+backward. Fully-masked (q-block, k-block) tiles skip all compute via
+``pl.when`` on the block indices.
+
+Backward (custom VJP, two kernels — the standard flash decomposition):
+    delta = rowsum(dO ⊙ O)                       (XLA, one fused reduce)
+    dQ kernel: grid (B·H, Tq/Bq, Tk/Bk):  P = exp(S − lse);
+        dS = P ⊙ (dO Vᵀ − delta);  dQ += dS K · scale
+    dK/dV kernel: grid (B·H, Tk/Bk, Tq/Bq):  Pᵀ on the transposed tile;
+        dV += Pᵀ dO;  dK += dSᵀ Q · scale
+Both recompute P from (q, k, lse) — O(T) memory, matmuls on the MXU.
+
+``window=w`` = each query sees keys s ∈ (t−w, t]. Masks are structural
+(computed from block indices + iota), so sliding-window skips every tile
+outside the band — cost O(T·w), not O(T²).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+_NEG = -1e30
+
+
+def _tile_mask(rows: Array, cols: Array, causal: bool, window: Optional[int], t_k: int):
+    """Boolean (Bq, Bk) tile of the structural mask at absolute row/col ids."""
+    m = cols < t_k  # mask out key padding
+    if causal:
+        m &= rows >= cols
+    if window is not None:
+        m &= (rows - cols) < window
+    return m
+
+
+def _skip_tile(qi, ki, bq, bk, causal, window):
+    """True if tile (qi, ki) is entirely masked (static-shape predicate)."""
+    skip = jnp.bool_(False)
+    if causal:
+        skip |= ki * bk > qi * bq + (bq - 1)  # first key row past last query
+    if window is not None:
+        skip |= (qi * bq) - (ki * bk + bk - 1) >= window  # band entirely left
+    return skip
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale, causal, window, t_k, bq, bk, nk,
+):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window)))
+    def _():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (Bq, Bk)
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(_tile_mask(rows, cols, causal, window, t_k), s, _NEG)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # (Bq, Bk) fp32
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p, v_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_scr[:]
+        safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (padding) -> 0
+        o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(safe))[:, 0]
+
+
+def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret):
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    dv = v.shape[-1]
+    pq, pk = (-t_q) % bq, (-t_k) % bk
+    qp = jnp.pad(q, ((0, 0), (0, pq), (0, 0))) if pq else q
+    kp = jnp.pad(k, ((0, 0), (0, pk), (0, 0))) if pk else k
+    vp = jnp.pad(v, ((0, 0), (0, pk), (0, 0))) if pk else v
+    nq, nk = qp.shape[1] // bq, kp.shape[1] // bk
+
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, window=window,
+        t_k=t_k, bq=bq, bk=bk, nk=nk,
+    )
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, dv), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, nq * bq, dv), q.dtype),
+            jax.ShapeDtypeStruct((bh, nq * bq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, dv), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :t_q, :], lse[:, :t_q]
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale, causal, window, t_k, bq, bk, nk,
+):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window)))
+    def _():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = _tile_mask(rows, cols, causal, window, t_k)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_scr[:] = dq_scr[:] + jnp.dot(
+            ds, k_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, scale, causal, window, t_k, bq, bk, nq,
+):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window)))
+    def _():
+        st = jax.lax.dot_general(
+            k_ref[0], q_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (Bk, Bq) = transposed scores
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
+        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+        mask = _tile_mask(rows, cols, causal, window, t_k)
+        pt = jnp.where(mask, jnp.exp(st - lse_ref[0][None, :]), 0.0)
+        dv_scr[:] = dv_scr[:] + jnp.dot(
+            pt, do_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        dpt = jax.lax.dot_general(
+            v_ref[0], do_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (Bk, Bq)
+        dst = pt * (dpt - delta_ref[0][None, :]) * scale
+        dk_scr[:] = dk_scr[:] + jnp.dot(
+            dst, q_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpret):
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    dv = v.shape[-1]
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (BH, Tq)
+
+    pq, pk = (-t_q) % bq, (-t_k) % bk
+    padq = lambda x: jnp.pad(x, ((0, 0), (0, pq), (0, 0))) if pq else x  # noqa: E731
+    padk = lambda x: jnp.pad(x, ((0, 0), (0, pk), (0, 0))) if pk else x  # noqa: E731
+    pad1 = lambda x: jnp.pad(x, ((0, 0), (0, pq))) if pq else x  # noqa: E731
+    qp, kp, vp, gp = padq(q), padk(k), padk(v), padq(g)
+    # Padded query rows have lse=0 => p = exp(-1e30 * scale ... ) — ensure
+    # their P is zero via the t_k col mask plus a huge lse.
+    lsep = pad1(lse) if not pq else jnp.pad(lse, ((0, 0), (0, pq)), constant_values=jnp.inf)
+    deltap = pad1(delta)
+    nq, nk = qp.shape[1] // bq, kp.shape[1] // bk
+
+    dq_kern = functools.partial(
+        _dq_kernel, scale=scale, causal=causal, window=window,
+        t_k=t_k, bq=bq, bk=bk, nk=nk,
+    )
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, dv), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, nq * bq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lsep, deltap)
+
+    dkv_kern = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal, window=window,
+        t_k=t_k, bq=bq, bk=bk, nq=nq,
+    )
+    dk, dv_ = pl.pallas_call(
+        dkv_kern,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, dv), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, nk * bk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, nk * bk, dv), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, dv), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lsep, deltap)
+    return dq[:, :t_q, :], dk[:, :t_k, :], dv_[:, :t_k, :]
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wiring + public entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, window, bq, bk, interpret):
+    out, _ = _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, window, bq, bk, interpret):
+    out, lse = _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(scale, causal, window, bq, bk, interpret, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd_flat(
+        q, k, v, out, lse, g.astype(q.dtype), scale, causal, window, bq, bk, interpret
+    )
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> Array:
+    """Flash attention over [..., T, D] per-head tensors. Differentiable."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    batch_shape = q.shape[:-2]
+    t_q, d = q.shape[-2:]
+    t_k, dv = k.shape[-2], v.shape[-1]
+    bh = 1
+    for s in batch_shape:
+        bh *= s
+    bq = min(block_q, max(t_q, 8))
+    bk = min(block_k, max(t_k, 8))
+    out = _flash(
+        q.reshape(bh, t_q, d),
+        k.reshape(bh, t_k, d),
+        v.reshape(bh, t_k, dv),
+        float(scale), causal, window, bq, bk, interpret,
+    )
+    return out.reshape(*batch_shape, t_q, dv)
+
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,152 @@
+"""Exact softmax attention: full, causal, and sliding-window — XLA path.
+
+The reference runs softmax attention for the LRA comparison configs and
+sliding-window softmax layers inside the 7B hybrid model (BASELINE.json
+north_star; the reference checkout was never mounted — SURVEY.md §0). This
+module is the pure-XLA implementation used as (a) the parity reference for
+the Pallas flash kernel and (b) the fallback on CPU and for mask shapes the
+kernel doesn't cover. ``ops/pallas/flash_attention.py`` is the TPU-native
+fast path (online softmax, no T×T materialization).
+
+Conventions: q, k, v are per-head tensors [..., T, D]; softmax in fp32;
+output in input dtype. ``window=w`` means each query attends to keys
+s ∈ (t-w, t] (its own position plus w-1 predecessors).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_NEG = -1e30  # large-negative instead of -inf: keeps all-masked rows NaN-free
+
+
+def _build_mask(
+    t_q: int,
+    t_k: int,
+    causal: bool,
+    window: Optional[int],
+    offset: int = 0,
+) -> Optional[Array]:
+    """Boolean [Tq, Tk] mask (True = attend). ``offset`` shifts query rows,
+    for decode-time queries positioned at the end of a longer key sequence."""
+    if not causal and window is None:
+        return None
+    row = jnp.arange(t_q)[:, None] + offset
+    col = jnp.arange(t_k)[None, :]
+    m = jnp.ones((t_q, t_k), dtype=bool)
+    if causal:
+        m &= row >= col
+    if window is not None:
+        m &= (row - col) < window
+    return m
+
+
+def softmax_attention_xla(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+    mask: Optional[Array] = None,
+    scale: Optional[float] = None,
+) -> Array:
+    """Materializing softmax attention (the parity/fallback path).
+
+    ``mask``: optional boolean, broadcastable to [..., Tq, Tk] (True=attend);
+    combined with the causal/window mask. A key-padding mask [..., Tk] is
+    accepted and broadcast over queries.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32) * scale
+    scores = jnp.einsum("...td,...sd->...ts", qf, k.astype(jnp.float32))
+
+    m = _build_mask(q.shape[-2], k.shape[-2], causal, window)
+    if mask is not None:
+        if mask.ndim < 2 or mask.shape[-2] != q.shape[-2]:
+            mask = mask[..., None, :]  # key-padding [..., Tk] -> over queries
+        m = mask if m is None else (m & mask)
+    if m is not None:
+        scores = jnp.where(m, scores, _NEG)
+
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("...ts,...sd->...td", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def softmax_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+    mask: Optional[Array] = None,
+    scale: Optional[float] = None,
+    backend: str = "auto",
+    block_q: int = 128,
+    block_k: int = 128,
+) -> Array:
+    """Dispatching softmax attention: Pallas flash on TPU, XLA elsewhere.
+
+    Arbitrary ``mask`` tensors force the XLA path (the flash kernel covers
+    the structured causal/window masks only).
+    """
+    from orion_tpu.ops.dispatch import resolve
+
+    b = resolve(backend)
+    if b in ("pallas", "pallas_interpret") and mask is None:
+        from orion_tpu.ops.pallas import flash_attention as fa
+
+        return fa.flash_attention(
+            q,
+            k,
+            v,
+            causal=causal,
+            window=window,
+            scale=scale,
+            block_q=block_q,
+            block_k=block_k,
+            interpret=(b == "pallas_interpret"),
+        )
+    return softmax_attention_xla(
+        q, k, v, causal=causal, window=window, mask=mask, scale=scale
+    )
+
+
+def cached_attention(
+    q: Array,
+    k_cache: Array,
+    v_cache: Array,
+    valid: Array,
+    *,
+    scale: Optional[float] = None,
+) -> Array:
+    """Decode-step attention of a single query over a KV cache.
+
+    q: [..., D]; caches: [..., S, D]; valid: boolean [..., S] marking filled
+    slots (works for both the growing full cache and the sliding-window ring
+    buffer, where slot order ≠ time order — softmax is permutation-invariant
+    over keys, so ring-buffer rotation needs no unrotation).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32) * scale
+    scores = jnp.einsum("...d,...sd->...s", qf, k_cache.astype(jnp.float32))
+    scores = jnp.where(valid, scores, _NEG)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("...s,...sd->...d", p, v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+__all__ = [
+    "softmax_attention",
+    "softmax_attention_xla",
+    "cached_attention",
+]

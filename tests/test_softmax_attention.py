@@ -1,0 +1,135 @@
+"""Parity tests: XLA softmax attention vs Pallas flash (interpret mode),
+values and grads, across causal/bidirectional/sliding-window; plus the
+decode-time cached-attention invariant. Mirrors the reference's
+CPU-vs-CUDA parity fixtures (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.ops.pallas.flash_attention import flash_attention
+from orion_tpu.ops.softmax_attention import (
+    cached_attention,
+    softmax_attention_xla,
+)
+
+
+def _rand(key, *shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype=jnp.float32).astype(dtype)
+
+
+@pytest.mark.parametrize("causal,window", [(True, None), (False, None), (True, 7)])
+@pytest.mark.parametrize("t", [32, 50])
+def test_flash_matches_xla(causal, window, t):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = _rand(k1, 2, 3, t, 16)
+    k = _rand(k2, 2, 3, t, 16)
+    v = _rand(k3, 2, 3, t, 16)
+    ref = softmax_attention_xla(q, k, v, causal=causal, window=window)
+    got = flash_attention(
+        q, k, v, causal=causal, window=window, block_q=16, block_k=16, interpret=True
+    )
+    np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = _rand(k1, 2, 24, 16, dtype=jnp.bfloat16)
+    k = _rand(k2, 2, 24, 16, dtype=jnp.bfloat16)
+    v = _rand(k3, 2, 24, 16, dtype=jnp.bfloat16)
+    ref = softmax_attention_xla(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, block_q=8, block_k=8, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        got.astype(np.float32), ref.astype(np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+@pytest.mark.parametrize("causal,window", [(True, None), (False, None), (True, 5)])
+def test_flash_grads_match_xla(causal, window):
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(2), 4)
+    t = 20
+    q = _rand(k1, 2, t, 8)
+    k = _rand(k2, 2, t, 8)
+    v = _rand(k3, 2, t, 8)
+    w = _rand(k4, 2, t, 8)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            softmax_attention_xla(q, k, v, causal=causal, window=window) * w
+        )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=causal, window=window,
+                block_q=8, block_k=8, interpret=True,
+            )
+            * w
+        )
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)
+
+
+def test_key_padding_mask():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand(k1, 2, 10, 8)
+    k = _rand(k2, 2, 10, 8)
+    v = _rand(k3, 2, 10, 8)
+    mask = jnp.arange(10)[None, :] < jnp.array([6, 9])[:, None]  # [B, Tk]
+    out = softmax_attention_xla(q, k, v, causal=False, mask=mask)
+    # truncating to the valid prefix must give the same rows
+    out6 = softmax_attention_xla(q[0:1], k[0:1, :6], v[0:1, :6], causal=False)
+    np.testing.assert_allclose(out[0], out6[0], atol=1e-5, rtol=1e-5)
+
+
+def test_cached_attention_matches_full():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(4), 3)
+    t, d = 12, 8
+    q = _rand(k1, 2, t, d)
+    k = _rand(k2, 2, t, d)
+    v = _rand(k3, 2, t, d)
+    full = softmax_attention_xla(q, k, v, causal=True)
+    smax = 16  # cache capacity > t
+    kc = jnp.pad(k, ((0, 0), (0, smax - t), (0, 0)))
+    vc = jnp.pad(v, ((0, 0), (0, smax - t), (0, 0)))
+    for step in [0, 3, t - 1]:
+        valid = jnp.arange(smax)[None, :] <= step
+        got = cached_attention(q[:, step], kc, vc, valid)
+        np.testing.assert_allclose(got, full[:, step], atol=1e-5, rtol=1e-5)
+
+
+def test_cached_attention_ring_buffer_window():
+    """Sliding-window decode with a rotated ring buffer == windowed attention."""
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(5), 3)
+    t, d, w = 10, 8, 4
+    q = _rand(k1, 1, t, d)
+    k = _rand(k2, 1, t, d)
+    v = _rand(k3, 1, t, d)
+    full = softmax_attention_xla(q, k, v, causal=True, window=w)
+    step = 7  # attends to positions 4..7, ring slots hold 4,5,6,7 rotated
+    slots = [(step - i) % w for i in range(w)]  # slot for position step-i
+    kc = jnp.zeros((1, w, d)).at[:, [s % w for s in range(step - w + 1, step + 1)]].set(
+        k[:, step - w + 1 : step + 1]
+    )
+    vc = jnp.zeros((1, w, d)).at[:, [s % w for s in range(step - w + 1, step + 1)]].set(
+        v[:, step - w + 1 : step + 1]
+    )
+    del slots
+    valid = jnp.ones((1, w), dtype=bool)
+    got = cached_attention(q[:, step], kc, vc, valid)
+    np.testing.assert_allclose(got, full[:, step], atol=1e-5, rtol=1e-5)
+
+
+def test_dispatch_backend_xla():
+    from orion_tpu.ops.softmax_attention import softmax_attention
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(6), 3)
+    q, k, v = _rand(k1, 1, 9, 8), _rand(k2, 1, 9, 8), _rand(k3, 1, 9, 8)
+    a = softmax_attention(q, k, v, backend="xla")
+    b = softmax_attention(q, k, v, backend="pallas_interpret", block_q=8, block_k=8)
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
