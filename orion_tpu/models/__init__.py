@@ -1,0 +1,20 @@
+"""Model families: TransformerLM (linear/softmax/swa/hybrid blocks) and the
+LRA classifier, plus named configs matching the reference's eval configs
+(BASELINE.json: tiny 2L/128d, 1.3B linear-attn, 7B hybrid, LRA)."""
+
+from orion_tpu.models.configs import (
+    ModelConfig,
+    CONFIGS,
+    get_config,
+)
+from orion_tpu.models.transformer import TransformerLM, init_decode_state
+from orion_tpu.models.classifier import LRAClassifier
+
+__all__ = [
+    "ModelConfig",
+    "CONFIGS",
+    "get_config",
+    "TransformerLM",
+    "LRAClassifier",
+    "init_decode_state",
+]

@@ -1,0 +1,168 @@
+"""Named model configs mirroring the reference's eval configs.
+
+BASELINE.json names five configs (the reference checkout was never mounted —
+SURVEY.md §0): tiny 2L/128d LM ("CPU eager ref"), LRA ListOps/Text with
+linear and softmax attention, 1.3B linear-attn LM (C4), 7B hybrid
+(sliding-window softmax + global linear), and the recurrent decode path.
+Each is a ``ModelConfig`` here; `get_config(name)` resolves them for the
+CLI. Configs are plain frozen dataclasses overridable via
+``dataclasses.replace`` or JSON/CLI flags (utils/config.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+def hybrid_pattern(n_layers: int, period: int = 4) -> Tuple[str, ...]:
+    """swa,swa,...,linear repeating: every ``period``-th layer is global
+    linear attention, the rest sliding-window softmax (the 7B hybrid
+    layout: local mixing cheap, global mixing O(T))."""
+    return tuple(
+        "linear" if (i + 1) % period == 0 else "swa" for i in range(n_layers)
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "tiny"
+    vocab_size: int = 32000
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    head_dim: Optional[int] = None  # default d_model // n_heads
+    mlp_hidden: Optional[int] = None  # default 4*d_model (gelu) / 8/3 (swiglu)
+    mlp: str = "swiglu"  # "swiglu" | "gelu"
+    norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    layer_types: Optional[Tuple[str, ...]] = None  # default all "linear"
+    window: int = 512  # swa window
+    feature_map: str = "elu1"  # linear-attn phi
+    max_seq_len: int = 2048
+    tie_embeddings: bool = True
+    dropout: float = 0.0
+    # numerics / execution
+    dtype: str = "bfloat16"  # activation/compute dtype
+    param_dtype: str = "float32"
+    backend: str = "auto"  # kernel dispatch for attention ops
+    chunk: int = 128  # linear-attn chunk size
+    remat: bool = False  # per-block activation checkpointing
+    # classifier-only
+    n_classes: int = 0  # >0 => LRA classifier head
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.d_model // self.n_heads
+
+    @property
+    def resolved_mlp_hidden(self) -> int:
+        if self.mlp_hidden:
+            return self.mlp_hidden
+        if self.mlp == "swiglu":
+            # 8/3 * d rounded up to a multiple of 128 (TPU lane width)
+            h = int(self.d_model * 8 / 3)
+            return max(128, (h + 127) // 128 * 128)
+        return 4 * self.d_model
+
+    @property
+    def resolved_layer_types(self) -> Tuple[str, ...]:
+        lt = self.layer_types or ("linear",) * self.n_layers
+        assert len(lt) == self.n_layers, (lt, self.n_layers)
+        for t in lt:
+            assert t in ("linear", "softmax", "swa"), t
+        return lt
+
+
+TINY = ModelConfig(
+    name="tiny",
+    vocab_size=256,  # byte-level
+    d_model=128,
+    n_layers=2,
+    n_heads=4,
+    max_seq_len=512,
+    dtype="float32",
+    remat=False,
+)
+
+LM_1B3 = ModelConfig(
+    name="lm_1b3",
+    vocab_size=32000,
+    d_model=2048,
+    n_layers=24,
+    n_heads=16,
+    max_seq_len=2048,
+    dtype="bfloat16",
+    remat=True,
+)
+
+HYBRID_7B = ModelConfig(
+    name="hybrid_7b",
+    vocab_size=32000,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    layer_types=hybrid_pattern(32, period=4),
+    window=1024,
+    max_seq_len=4096,
+    dtype="bfloat16",
+    remat=True,
+)
+
+LRA_LISTOPS_LINEAR = ModelConfig(
+    name="lra_listops_linear",
+    vocab_size=32,  # digits + operators + specials
+    d_model=128,
+    n_layers=4,
+    n_heads=4,
+    max_seq_len=2048,
+    layer_types=("linear",) * 4,
+    n_classes=10,
+    dtype="float32",
+    mlp="gelu",
+    norm="layernorm",
+)
+
+LRA_LISTOPS_SOFTMAX = dataclasses.replace(
+    LRA_LISTOPS_LINEAR, name="lra_listops_softmax", layer_types=("softmax",) * 4
+)
+
+LRA_TEXT_LINEAR = ModelConfig(
+    name="lra_text_linear",
+    vocab_size=256,  # byte level
+    d_model=256,
+    n_layers=4,
+    n_heads=4,
+    max_seq_len=4096,
+    layer_types=("linear",) * 4,
+    n_classes=2,
+    dtype="float32",
+    mlp="gelu",
+    norm="layernorm",
+)
+
+LRA_TEXT_SOFTMAX = dataclasses.replace(
+    LRA_TEXT_LINEAR, name="lra_text_softmax", layer_types=("softmax",) * 4
+)
+
+CONFIGS = {
+    c.name: c
+    for c in [
+        TINY,
+        LM_1B3,
+        HYBRID_7B,
+        LRA_LISTOPS_LINEAR,
+        LRA_LISTOPS_SOFTMAX,
+        LRA_TEXT_LINEAR,
+        LRA_TEXT_SOFTMAX,
+    ]
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    if name not in CONFIGS:
+        raise ValueError(f"unknown config {name!r}; have {sorted(CONFIGS)}")
+    cfg = CONFIGS[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+__all__ = ["ModelConfig", "CONFIGS", "get_config", "hybrid_pattern"]

@@ -1,0 +1,377 @@
+"""TransformerLM: decoder LM with per-layer linear / softmax / sliding-window
+attention, SwiGLU or GELU MLP, RMSNorm/LayerNorm, tied or untied head.
+
+The reference's model family (BASELINE.json: tiny 2L/128d, 1.3B linear-attn,
+7B hybrid swa+linear; the reference checkout was never mounted — SURVEY.md
+§0), rebuilt flax-first. Three entry methods per module, all jit-friendly:
+
+- ``__call__(tokens)``      — parallel training forward (chunked linear
+  attention / flash softmax via ops dispatch).
+- ``prefill(tokens)``       — same forward, additionally returning per-layer
+  decode state: linear layers hand back the kv-cumsum state (S, z); softmax
+  layers a KV cache; swa layers a ring-buffer window cache.
+- ``decode_step(tok, st, t)`` — one-token recurrent step, O(1) state for
+  linear layers; designed to sit inside a single ``lax.scan``.
+
+Positional scheme (SURVEY.md M6): learned absolute embeddings at the input
+(what the linear layers see — rotating phi-space vectors would break the
+kernel trick) + rotary applied inside softmax/swa layers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.models.configs import ModelConfig
+from orion_tpu.ops.feature_maps import make_feature_map
+from orion_tpu.ops.linear_attention import (
+    linear_attention,
+    linear_attention_noncausal,
+    recurrent_step,
+)
+from orion_tpu.ops.rotary import apply_rotary, apply_rotary_at, rotary_freqs
+from orion_tpu.ops.softmax_attention import cached_attention, softmax_attention
+
+Array = jax.Array
+State = Dict[str, Array]
+
+
+def _dtype(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
+
+
+def _norm(cfg: ModelConfig, name: str):
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(dtype=_dtype(cfg.dtype), name=name)
+    return nn.LayerNorm(dtype=_dtype(cfg.dtype), name=name)
+
+
+class Attention(nn.Module):
+    """One attention layer of type 'linear' | 'softmax' | 'swa'."""
+
+    cfg: ModelConfig
+    layer_type: str
+    causal: bool = True
+
+    def setup(self):
+        cfg = self.cfg
+        h, dh = cfg.n_heads, cfg.resolved_head_dim
+        dt, pdt = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        dense = lambda n, feats: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=dt, param_dtype=pdt, name=n
+        )
+        self.wq = dense("wq", h * dh)
+        self.wk = dense("wk", h * dh)
+        self.wv = dense("wv", h * dh)
+        self.wo = dense("wo", cfg.d_model)
+        if self.layer_type == "linear":
+            if cfg.feature_map == "learnable":
+                self.phi_proj = dense("phi_proj", dh)
+                self._phi = lambda x: jax.nn.elu(x) + 1.0
+            elif cfg.feature_map == "favor":
+                self.favor_w = self.param(
+                    "favor_proj",
+                    lambda rng: _favor_proj_init(rng, dh),
+                )
+                self._phi = None
+            else:
+                self._phi = make_feature_map(cfg.feature_map)
+        else:
+            # rotary angle table, a trace-time constant
+            self.freqs = rotary_freqs(dh, cfg.max_seq_len)
+
+    # -- shared projections -------------------------------------------------
+
+    def _heads(self, x: Array) -> Tuple[Array, Array, Array]:
+        """x [..., T, D] (or [..., D]) -> q,k,v [..., H, T, Dh] ([..., H, Dh])."""
+        cfg = self.cfg
+        h, dh = cfg.n_heads, cfg.resolved_head_dim
+        single = x.ndim == 2  # decode: [B, D]
+        q, k, v = self.wq(x), self.wk(x), self.wv(x)
+
+        def split(y):
+            if single:
+                return y.reshape(*y.shape[:-1], h, dh)  # [B, H, Dh]
+            y = y.reshape(*y.shape[:-1], h, dh)  # [B, T, H, Dh]
+            return jnp.swapaxes(y, -3, -2)  # [B, H, T, Dh]
+
+        return split(q), split(k), split(v)
+
+    def _phi_map(self, x: Array) -> Array:
+        cfg = self.cfg
+        if cfg.feature_map == "learnable":
+            return self._phi(self.phi_proj(x))
+        if cfg.feature_map == "favor":
+            w = jax.lax.stop_gradient(self.favor_w)  # fixed random features
+            xf = x.astype(jnp.float32) / (x.shape[-1] ** 0.25)
+            proj = jnp.einsum("...d,md->...m", xf, w)
+            sq = 0.5 * jnp.sum(xf * xf, axis=-1, keepdims=True)
+            return (jnp.exp(proj - sq) / jnp.sqrt(w.shape[0])).astype(x.dtype)
+        return self._phi(x)
+
+    def _merge(self, out: Array, single: bool) -> Array:
+        if not single:
+            out = jnp.swapaxes(out, -3, -2)  # [B, T, H, Dh]
+        return self.wo(out.reshape(*out.shape[:-2], -1))
+
+    # -- parallel forward ---------------------------------------------------
+
+    def __call__(self, x: Array, mask: Optional[Array] = None) -> Array:
+        cfg = self.cfg
+        q, k, v = self._heads(x)
+        t = x.shape[-2]
+        if self.layer_type == "linear":
+            qf, kf = self._phi_map(q), self._phi_map(k)
+            if self.causal:
+                out = linear_attention(
+                    qf, kf, v, backend=cfg.backend, chunk=cfg.chunk
+                )
+            else:
+                km = None if mask is None else mask[:, None, :]
+                out = linear_attention_noncausal(qf, kf, v, mask=km)
+        else:
+            ang = self.freqs[:t]
+            q = apply_rotary(q, ang)
+            k = apply_rotary(k, ang)
+            window = cfg.window if self.layer_type == "swa" else None
+            am = None if mask is None else mask[:, None, None, :]
+            out = softmax_attention(
+                q, k, v, causal=self.causal, window=window,
+                mask=am, backend=cfg.backend,
+            )
+        return self._merge(out, single=False)
+
+    # -- prefill: forward + decode state ------------------------------------
+
+    def prefill(self, x: Array) -> Tuple[Array, State]:
+        cfg = self.cfg
+        q, k, v = self._heads(x)
+        t = x.shape[-2]
+        if self.layer_type == "linear":
+            qf, kf = self._phi_map(q), self._phi_map(k)
+            out, (s, z) = linear_attention(
+                qf, kf, v, backend=cfg.backend, chunk=cfg.chunk, return_state=True
+            )
+            state = {"s": s, "z": z}
+        else:
+            ang = self.freqs[:t]
+            qr = apply_rotary(q, ang)
+            kr = apply_rotary(k, ang)
+            if self.layer_type == "swa":
+                out = softmax_attention(
+                    qr, kr, v, causal=True, window=cfg.window, backend=cfg.backend
+                )
+                state = _swa_cache_from_prefill(kr, v, t, cfg.window)
+            else:
+                out = softmax_attention(qr, kr, v, causal=True, backend=cfg.backend)
+                smax = cfg.max_seq_len
+                pad = ((0, 0), (0, 0), (0, smax - t), (0, 0))
+                state = {"k": jnp.pad(kr, pad), "v": jnp.pad(v, pad)}
+        return self._merge(out, single=False), state
+
+    # -- one-token decode ---------------------------------------------------
+
+    def decode_step(self, x: Array, state: State, t: Array) -> Tuple[Array, State]:
+        """x: [B, D] one token; t: scalar int32 absolute position."""
+        cfg = self.cfg
+        q, k, v = self._heads(x)  # [B, H, Dh]
+        if self.layer_type == "linear":
+            qf, kf = self._phi_map(q), self._phi_map(k)
+            out, (s, z) = recurrent_step(qf, kf, v, (state["s"], state["z"]))
+            new_state = {"s": s, "z": z}
+        else:
+            qr = apply_rotary_at(q, self.freqs, t)
+            kr = apply_rotary_at(k, self.freqs, t)
+            cap = state["k"].shape[-2]  # window W or max_seq_len
+            slot = t % cap if self.layer_type == "swa" else t
+            kc = jax.lax.dynamic_update_slice_in_dim(
+                state["k"], kr[:, :, None, :].astype(state["k"].dtype), slot, axis=2
+            )
+            vc = jax.lax.dynamic_update_slice_in_dim(
+                state["v"], v[:, :, None, :].astype(state["v"].dtype), slot, axis=2
+            )
+            # ring slots hold positions (t-W, t] once warm; before that,
+            # slots (t, W) are still unwritten — in both cases exactly the
+            # slots with index <= t are valid (softmax is permutation-
+            # invariant over keys, so rotation needs no unrotation).
+            valid = (jnp.arange(cap) <= t)[None, None, :]
+            out = cached_attention(qr, kc, vc, valid)
+            new_state = {"k": kc, "v": vc}
+        return self._merge(out, single=True), new_state
+
+
+def _favor_proj_init(rng: Array, dh: int) -> Array:
+    from orion_tpu.ops.feature_maps import _orthogonal_gaussian
+
+    return _orthogonal_gaussian(rng, dh, dh)
+
+
+def _swa_cache_from_prefill(kr: Array, v: Array, t: int, window: int) -> State:
+    """Build the ring-buffer cache from the last ``window`` prompt tokens,
+    each at slot (position % window); unwritten slots stay zero (they are
+    masked by the slot <= t rule in decode_step)."""
+    b, h, _, dh = kr.shape
+    start = max(0, t - window)
+    n = t - start
+    positions = jnp.arange(start, t)
+    slots = positions % window
+    kc = jnp.zeros((b, h, window, dh), kr.dtype).at[:, :, slots, :].set(
+        kr[:, :, start:t, :]
+    )
+    vc = jnp.zeros((b, h, window, v.shape[-1]), v.dtype).at[:, :, slots, :].set(
+        v[:, :, start:t, :]
+    )
+    del n
+    return {"k": kc, "v": vc}
+
+
+class MLP(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        cfg = self.cfg
+        dt, pdt = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        h = cfg.resolved_mlp_hidden
+        dense = lambda n, feats: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=dt, param_dtype=pdt, name=n
+        )
+        if cfg.mlp == "swiglu":
+            gate = dense("gate", h)(x)
+            up = dense("up", h)(x)
+            y = jax.nn.silu(gate) * up
+        else:
+            y = jax.nn.gelu(dense("up", h)(x))
+        return dense("down", cfg.d_model)(y)
+
+
+class Block(nn.Module):
+    """Pre-norm residual block: x + attn(norm(x)); x + mlp(norm(x))."""
+
+    cfg: ModelConfig
+    layer_type: str
+    causal: bool = True
+
+    def setup(self):
+        self.norm1 = _norm(self.cfg, "norm1")
+        self.attn = Attention(self.cfg, self.layer_type, self.causal, name="attn")
+        self.norm2 = _norm(self.cfg, "norm2")
+        self.mlp = MLP(self.cfg, name="mlp")
+        self.drop = nn.Dropout(self.cfg.dropout)
+
+    def __call__(self, x, mask=None, deterministic=True):
+        x = x + self.drop(self.attn(self.norm1(x), mask), deterministic=deterministic)
+        x = x + self.drop(self.mlp(self.norm2(x)), deterministic=deterministic)
+        return x
+
+    def prefill(self, x):
+        h, state = self.attn.prefill(self.norm1(x))
+        x = x + h
+        x = x + self.mlp(self.norm2(x))
+        return x, state
+
+    def decode_step(self, x, state, t):
+        h, state = self.attn.decode_step(self.norm1(x), state, t)
+        x = x + h
+        x = x + self.mlp(self.norm2(x))
+        return x, state
+
+
+class TransformerLM(nn.Module):
+    """Decoder LM over token ids; see module docstring for the 3 methods."""
+
+    cfg: ModelConfig
+
+    def setup(self):
+        cfg = self.cfg
+        pdt = _dtype(cfg.param_dtype)
+        self.embed = nn.Embed(cfg.vocab_size, cfg.d_model, param_dtype=pdt)
+        self.pos_embed = nn.Embed(cfg.max_seq_len, cfg.d_model, param_dtype=pdt)
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=(3,))
+        self.blocks = [
+            block_cls(cfg, lt, True, name=f"block_{i}")
+            for i, lt in enumerate(cfg.resolved_layer_types)
+        ]
+        self.final_norm = _norm(cfg, "final_norm")
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                param_dtype=pdt, name="lm_head",
+            )
+
+    def _embed(self, tokens: Array, positions: Array) -> Array:
+        x = self.embed(tokens) + self.pos_embed(positions)
+        return x.astype(_dtype(self.cfg.dtype))
+
+    def _head(self, x: Array) -> Array:
+        x = self.final_norm(x)
+        if self.cfg.tie_embeddings:
+            return self.embed.attend(x.astype(jnp.float32))
+        return self.lm_head(x.astype(jnp.float32))
+
+    def __call__(self, tokens: Array, deterministic: bool = True) -> Array:
+        """tokens [B, T] -> logits [B, T, V] (fp32)."""
+        t = tokens.shape[-1]
+        x = self._embed(tokens, jnp.arange(t))
+        for blk in self.blocks:
+            x = blk(x, None, deterministic)
+        return self._head(x)
+
+    def prefill(self, tokens: Array) -> Tuple[Array, List[State]]:
+        """tokens [B, T] -> (logits [B, T, V], per-layer decode states)."""
+        t = tokens.shape[-1]
+        x = self._embed(tokens, jnp.arange(t))
+        states = []
+        for blk in self.blocks:
+            x, st = blk.prefill(x)
+            states.append(st)
+        return self._head(x), states
+
+    def decode_step(
+        self, token: Array, states: List[State], t: Array
+    ) -> Tuple[Array, List[State]]:
+        """token [B] -> (logits [B, V], updated states). t: scalar position."""
+        x = self._embed(token, t)
+        new_states = []
+        for blk, st in zip(self.blocks, states):
+            x, st = blk.decode_step(x, st, t)
+            new_states.append(st)
+        return self._head(x), new_states
+
+
+def init_decode_state(
+    cfg: ModelConfig, batch_size: int, dtype: Any = None
+) -> List[State]:
+    """Zero decode state matching prefill's structure (for prompt-less
+    generation). Linear layers: fp32 (S, z); softmax: [B,H,Smax,Dh] KV cache;
+    swa: [B,H,W,Dh] ring cache."""
+    dt = dtype or _dtype(cfg.dtype)
+    h, dh = cfg.n_heads, cfg.resolved_head_dim
+    b = batch_size
+    states: List[State] = []
+    for lt in cfg.resolved_layer_types:
+        if lt == "linear":
+            states.append(
+                {
+                    "s": jnp.zeros((b, h, dh, dh), jnp.float32),
+                    "z": jnp.zeros((b, h, dh), jnp.float32),
+                }
+            )
+        else:
+            cap = cfg.window if lt == "swa" else cfg.max_seq_len
+            states.append(
+                {
+                    "k": jnp.zeros((b, h, cap, dh), dt),
+                    "v": jnp.zeros((b, h, cap, dh), dt),
+                }
+            )
+    return states
+
+
+__all__ = ["TransformerLM", "Attention", "Block", "MLP", "init_decode_state"]

@@ -1,0 +1,139 @@
+"""Model tests (SURVEY.md §4): forward shape/finiteness, and the decisive
+linear-attention invariant — parallel forward == prefill + recurrent decode
+— on a model mixing all three layer types."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.models import (
+    LRAClassifier,
+    ModelConfig,
+    TransformerLM,
+    get_config,
+    init_decode_state,
+)
+
+MIXED = ModelConfig(
+    name="mixed_test",
+    vocab_size=64,
+    d_model=32,
+    n_layers=3,
+    n_heads=2,
+    layer_types=("linear", "softmax", "swa"),
+    window=4,
+    max_seq_len=32,
+    dtype="float32",
+    backend="xla",
+)
+
+
+def test_lm_forward_shapes():
+    cfg = get_config("tiny", backend="xla")
+    model = TransformerLM(cfg)
+    toks = jnp.arange(2 * 16).reshape(2, 16) % cfg.vocab_size
+    params = model.init(jax.random.PRNGKey(0), toks)
+    logits = model.apply(params, toks)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+@pytest.mark.parametrize("cfg_over", [{}, {"mlp": "gelu", "norm": "layernorm",
+                                           "tie_embeddings": False}])
+def test_lm_variants(cfg_over):
+    cfg = dataclasses.replace(MIXED, **cfg_over)
+    model = TransformerLM(cfg)
+    toks = jnp.arange(2 * 12).reshape(2, 12) % cfg.vocab_size
+    params = model.init(jax.random.PRNGKey(1), toks)
+    logits = model.apply(params, toks)
+    assert logits.shape == (2, 12, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+@pytest.mark.parametrize("feature_map", ["elu1", "learnable", "favor"])
+def test_parallel_vs_prefill_decode_parity(feature_map):
+    """logits from one parallel forward == prefill(T0) then T-T0 decode steps."""
+    cfg = dataclasses.replace(MIXED, feature_map=feature_map)
+    model = TransformerLM(cfg)
+    t, t0 = 14, 6
+    toks = (jax.random.randint(jax.random.PRNGKey(2), (2, t), 0, cfg.vocab_size))
+    params = model.init(jax.random.PRNGKey(3), toks)
+
+    full = model.apply(params, toks)  # [B, T, V]
+
+    pre_logits, states = model.apply(params, toks[:, :t0], method="prefill")
+    np.testing.assert_allclose(pre_logits, full[:, :t0], atol=1e-4, rtol=1e-4)
+
+    got = []
+    for step in range(t0, t):
+        logits, states = model.apply(
+            params, toks[:, step], states, jnp.int32(step), method="decode_step"
+        )
+        got.append(logits)
+    got = jnp.stack(got, axis=1)
+    np.testing.assert_allclose(got, full[:, t0:], atol=1e-4, rtol=1e-4)
+
+
+def test_decode_from_zero_state():
+    """init_decode_state matches prefill's pytree structure and decoding from
+    scratch equals the parallel forward."""
+    model = TransformerLM(MIXED)
+    t = 8
+    toks = jax.random.randint(jax.random.PRNGKey(4), (1, t), 0, MIXED.vocab_size)
+    params = model.init(jax.random.PRNGKey(5), toks)
+    full = model.apply(params, toks)
+
+    states = init_decode_state(MIXED, batch_size=1, dtype=jnp.float32)
+    _, pstates = model.apply(params, toks[:, :1], method="prefill")
+    assert jax.tree.structure(states) == jax.tree.structure(pstates)
+
+    got = []
+    for step in range(t):
+        logits, states = model.apply(
+            params, toks[:, step], states, jnp.int32(step), method="decode_step"
+        )
+        got.append(logits)
+    np.testing.assert_allclose(
+        jnp.stack(got, axis=1), full, atol=1e-4, rtol=1e-4
+    )
+
+
+def test_classifier_padding_invariance():
+    cfg = get_config("lra_listops_linear", max_seq_len=64, backend="xla")
+    model = LRAClassifier(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(6), (2, 20), 0, cfg.vocab_size)
+    mask = jnp.ones((2, 20), dtype=bool)
+    params = model.init(jax.random.PRNGKey(7), toks, mask)
+    base = model.apply(params, toks, mask)
+    assert base.shape == (2, cfg.n_classes)
+
+    # padding tokens behind the mask must not change logits
+    toks_pad = jnp.concatenate([toks, jnp.full((2, 5), 3)], axis=1)
+    mask_pad = jnp.concatenate([mask, jnp.zeros((2, 5), dtype=bool)], axis=1)
+    padded = model.apply(params, toks_pad, mask_pad)
+    np.testing.assert_allclose(padded, base, atol=1e-5, rtol=1e-5)
+
+
+def test_classifier_softmax_variant():
+    cfg = get_config("lra_listops_softmax", max_seq_len=64, backend="xla")
+    model = LRAClassifier(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(8), (2, 16), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(9), toks)
+    out = model.apply(params, toks)
+    assert out.shape == (2, cfg.n_classes)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_remat_matches_no_remat():
+    cfg = dataclasses.replace(MIXED, remat=False)
+    cfg_r = dataclasses.replace(MIXED, remat=True)
+    toks = jax.random.randint(jax.random.PRNGKey(10), (1, 10), 0, cfg.vocab_size)
+    m, mr = TransformerLM(cfg), TransformerLM(cfg_r)
+    params = m.init(jax.random.PRNGKey(11), toks)
+    np.testing.assert_allclose(
+        m.apply(params, toks), mr.apply(params, toks), atol=1e-6, rtol=1e-6
+    )
