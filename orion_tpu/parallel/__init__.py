@@ -1,0 +1,24 @@
+"""Parallelism: device mesh, sharding rules, sequence-parallel linear
+attention, ring attention, collective wrappers.
+
+Replaces the reference's torch.distributed/NCCL layer (BASELINE.json;
+reference checkout never mounted — SURVEY.md §0) with the TPU-native model:
+one ``jax.sharding.Mesh`` with axes (dp, fsdp, tp, sp), params/batch
+annotated with NamedSharding, XLA inserting the collectives over ICI/DCN.
+"""
+
+from orion_tpu.parallel.mesh import MeshConfig, make_mesh, initialize_distributed
+from orion_tpu.parallel.sharding import (
+    batch_sharding,
+    param_shardings,
+    shard_params,
+)
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "initialize_distributed",
+    "batch_sharding",
+    "param_shardings",
+    "shard_params",
+]

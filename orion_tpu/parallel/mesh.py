@@ -1,0 +1,101 @@
+"""Device mesh construction: axes (dp, fsdp, tp, sp) + multi-host init.
+
+The TPU replacement for the reference's NCCL process-group setup
+(BASELINE.json; reference checkout never mounted — SURVEY.md §0): instead
+of ranks + communicators, one logical ``jax.sharding.Mesh`` over all chips.
+Axis meaning:
+
+- ``dp``   — pure data parallelism (batch sharded, grads psum'd)
+- ``fsdp`` — data parallelism + ZeRO-style param sharding (all_gather on
+  use, reduce_scatter on grads; XLA emits these from the shardings)
+- ``tp``   — tensor parallelism (heads / MLP hidden sharded)
+- ``sp``   — sequence/context parallelism (ring attention, SP linear attn)
+
+On multi-host (v4/v5 pods), lay dp/fsdp over DCN-connected slices and
+tp/sp within a slice so heavy collectives ride ICI —
+``make_mesh(..., dcn_dp=N)`` uses ``create_hybrid_device_mesh``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes per axis; -1 on dp = absorb all remaining devices."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        known = self.fsdp * self.tp * self.sp
+        dp = self.dp
+        if dp == -1:
+            assert n_devices % known == 0, (n_devices, self)
+            dp = n_devices // known
+        total = dp * known
+        assert total <= n_devices, (
+            f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp} > {n_devices} devices"
+        )
+        return MeshConfig(dp, self.fsdp, self.tp, self.sp)
+
+    @property
+    def shape(self):
+        return (self.dp, self.fsdp, self.tp, self.sp)
+
+
+def make_mesh(
+    cfg: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    dcn_dp: int = 1,
+) -> Mesh:
+    """Build the (dp, fsdp, tp, sp) mesh. Single chip => all axes size 1.
+
+    ``dcn_dp > 1``: multi-slice layout — dp spans DCN, other axes ICI.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    cfg = (cfg or MeshConfig()).resolve(len(devices))
+    n = cfg.dp * cfg.fsdp * cfg.tp * cfg.sp
+    devices = devices[:n]  # explicit sub-mesh (e.g. single-device tests)
+    if dcn_dp > 1:
+        assert cfg.dp % dcn_dp == 0, (cfg, dcn_dp)
+        per_slice = (cfg.dp // dcn_dp, cfg.fsdp, cfg.tp, cfg.sp)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            per_slice, (dcn_dp, 1, 1, 1), devices=devices
+        )
+    else:
+        dev_array = np.asarray(devices).reshape(cfg.shape)
+    return Mesh(dev_array, AXES)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up (the reference's dist.init_process_group
+    equivalent). On TPU pods all args are auto-discovered; on CPU/GPU
+    clusters pass them explicitly. No-op if already initialized."""
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:  # already initialized
+        if "already" not in str(e).lower():
+            raise
+
+
+__all__ = ["AXES", "MeshConfig", "make_mesh", "initialize_distributed"]

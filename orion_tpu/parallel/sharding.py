@@ -1,0 +1,102 @@
+"""Sharding rules: param-path → PartitionSpec over the (dp, fsdp, tp, sp) mesh.
+
+Where the reference wraps the model in a NCCL DDP/ZeRO wrapper
+(BASELINE.json; reference checkout never mounted — SURVEY.md §0), here the
+whole strategy is a set of NamedSharding annotations; jit + GSPMD emit the
+all_gathers / reduce_scatters / psums over ICI. Megatron-style TP layout:
+
+- attention wq/wk/wv kernels [d, h·dh]:  P('fsdp', 'tp')  (heads on tp)
+- attention wo kernel [h·dh, d]:         P('tp', 'fsdp')  (psum at output)
+- MLP gate/up [d, hidden]:               P('fsdp', 'tp')
+- MLP down [hidden, d]:                  P('tp', 'fsdp')
+- embeddings [V, d] / pos [T, d]:        P(None, 'fsdp')
+- norms / biases / scalars:              replicated
+
+fsdp shards the non-tp dim (ZeRO-3: params gathered per-layer on use).
+Batch is sharded over (dp, fsdp) — fsdp doubles as a data axis.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# ordered (regex over 'path/to/param', spec) rules — first match wins
+_RULES = (
+    (r"(wq|wk|wv|gate|up|phi_proj)/kernel$", P("fsdp", "tp")),
+    (r"(wo|down)/kernel$", P("tp", "fsdp")),
+    (r"lm_head/kernel$", P("fsdp", "tp")),
+    (r"head/kernel$", P("fsdp", None)),
+    (r"(embed|embedding|pos_embed)/embedding$", P(None, "fsdp")),
+    (r"favor_proj$", P(None, None)),
+    (r"", P()),  # norms, biases, cls, everything else: replicated
+)
+
+
+def spec_for_path(path: str) -> P:
+    for pat, spec in _RULES:
+        if re.search(pat, path):
+            return spec
+    return P()
+
+
+def _tree_paths(tree: Any) -> Any:
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, _: "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in kp
+        ),
+        tree,
+    )
+
+
+def param_shardings(abstract_params: Any, mesh: Mesh) -> Any:
+    """Pytree of NamedSharding matching ``abstract_params`` (from
+    jax.eval_shape of model.init). Specs are clipped: a dim whose size
+    doesn't divide the mesh axis falls back to replicated on that dim."""
+
+    def make(path: str, leaf) -> NamedSharding:
+        spec = spec_for_path(path)
+        dims = []
+        for i, ax in enumerate(spec):
+            if ax is None or i >= leaf.ndim:
+                dims.append(None)
+                continue
+            if leaf.shape[i] % mesh.shape[ax] == 0:
+                dims.append(ax)
+            else:
+                dims.append(None)
+        dims = dims[: leaf.ndim]
+        return NamedSharding(mesh, P(*dims))
+
+    paths = _tree_paths(abstract_params)
+    return jax.tree.map(make, paths, abstract_params)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place an already-materialized param tree according to the rules."""
+    sh = param_shardings(jax.eval_shape(lambda: params), mesh)
+    return jax.device_put(params, sh)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dim sharded over (dp, fsdp); sequence dim over sp (sequence
+    parallelism slices the tokens too); everything else replicated."""
+    if mesh.shape["sp"] > 1:
+        return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    return NamedSharding(mesh, P(("dp", "fsdp")))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+__all__ = [
+    "spec_for_path",
+    "param_shardings",
+    "shard_params",
+    "batch_sharding",
+    "replicated",
+]

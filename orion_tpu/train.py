@@ -1,0 +1,140 @@
+"""`python -m orion_tpu.train` — the training entrypoint (SURVEY.md T1).
+
+TPU-native counterpart of the reference's `orion.train` (BASELINE.json;
+reference checkout never mounted — SURVEY.md §0). Library use:
+
+    from orion_tpu.train import train
+    state, metrics = train(TrainConfig(model=get_config("tiny"), steps=100),
+                           data="synthetic")
+
+CLI:
+
+    python -m orion_tpu.train --config tiny --steps 1000 --data synthetic \
+        --set lr=1e-3 --set model.n_layers=4 --ckpt-dir /tmp/ckpt
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import Optional, Tuple
+
+from orion_tpu.models.configs import get_config
+from orion_tpu.parallel.mesh import MeshConfig, initialize_distributed
+from orion_tpu.training.checkpoint import Checkpointer
+from orion_tpu.training.data import DataLoader, make_dataset
+from orion_tpu.training.metrics import MetricsLogger
+from orion_tpu.training.trainer import TrainConfig, Trainer
+
+
+def train(
+    cfg: TrainConfig,
+    data: str = "synthetic",
+    log_path: Optional[str] = None,
+    resume: bool = True,
+) -> Tuple[object, dict]:
+    """Build everything, optionally resume, run to cfg.steps. Returns
+    (final TrainState, last metrics dict)."""
+    trainer = Trainer(cfg)
+    ckpt = None
+    start = 0
+    if cfg.ckpt_dir:
+        ckpt = Checkpointer(
+            cfg.ckpt_dir, max_to_keep=cfg.ckpt_keep, save_every=cfg.ckpt_every
+        )
+        if resume and ckpt.latest_step is not None:
+            start = trainer.restore(ckpt)
+            print(f"resumed from step {start}", file=sys.stderr)
+
+    dataset = make_dataset(data, cfg.seq_len, cfg.model.vocab_size)
+    assert dataset.vocab_size <= cfg.model.vocab_size, (
+        f"data vocab {dataset.vocab_size} > model vocab {cfg.model.vocab_size}"
+    )
+    loader = DataLoader(
+        dataset,
+        cfg.batch_size,
+        seed=cfg.seed,
+        start_step=start,
+        sharding=trainer.batch_shd,
+    )
+    logger = MetricsLogger(log_path)
+    try:
+        last = trainer.train(iter(loader), logger=logger, ckpt=ckpt)
+        if cfg.eval_every:
+            eval_loader = DataLoader(
+                dataset, cfg.batch_size, seed=cfg.seed + 1,
+                start_step=10_000_000, sharding=trainer.batch_shd,
+            )
+            try:
+                last.update(trainer.evaluate(iter(eval_loader)))
+            finally:
+                eval_loader.close()
+        if ckpt is not None:
+            ckpt.maybe_save(int(trainer.state.step), trainer.state, force=True)
+            ckpt.wait()
+    finally:
+        loader.close()
+        logger.close()
+    return trainer.state, last
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("orion_tpu.train")
+    p.add_argument("--config", default="tiny", help="named model config")
+    p.add_argument("--data", default="synthetic", help="'synthetic' or token-bin path")
+    p.add_argument("--steps", type=int, default=1000)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--log-path", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dp", type=int, default=-1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--distributed", action="store_true", help="multi-host init")
+    p.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="dotted TrainConfig override, e.g. --set model.n_layers=4",
+    )
+    p.add_argument("--config-json", default=None, help="JSON override file")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    if args.distributed:
+        initialize_distributed()
+    from orion_tpu.utils.config import apply_overrides, load_json_overrides
+
+    cfg = TrainConfig(
+        model=get_config(args.config),
+        steps=args.steps,
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        lr=args.lr,
+        seed=args.seed,
+        ckpt_dir=args.ckpt_dir,
+        mesh=MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp),
+    )
+    if args.config_json:
+        cfg = apply_overrides(cfg, load_json_overrides(args.config_json))
+    overrides = {}
+    for kv in args.set:
+        k, _, v = kv.partition("=")
+        overrides[k] = v
+    if overrides:
+        cfg = apply_overrides(cfg, overrides)
+    if cfg.seq_len >= cfg.model.max_seq_len:
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, max_seq_len=cfg.seq_len + 1)
+        )
+    _, last = train(cfg, data=args.data, log_path=args.log_path)
+    print({k: round(v, 5) for k, v in last.items()})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

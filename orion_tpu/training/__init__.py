@@ -1,0 +1,18 @@
+"""Training subsystem: trainer, data pipeline, checkpointing, metrics."""
+
+from orion_tpu.training.trainer import Trainer, TrainConfig
+from orion_tpu.training.data import (
+    SyntheticDataset,
+    TokenBinDataset,
+    DataLoader,
+    write_token_bin,
+)
+
+__all__ = [
+    "Trainer",
+    "TrainConfig",
+    "SyntheticDataset",
+    "TokenBinDataset",
+    "DataLoader",
+    "write_token_bin",
+]

@@ -1,0 +1,74 @@
+"""Checkpoint/resume via orbax (SURVEY.md T4): async save, retention,
+sharded restore.
+
+The state saved is the whole TrainState pytree (params + optimizer state +
+step + root rng key); the data pipeline needs no state because batches are
+pure functions of (seed, step) — resume re-derives the stream from the
+restored step (training/data.py). Restoring onto a mesh passes the target
+shardings so orbax lands shards directly on their devices."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class Checkpointer:
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        async_save: bool = True,
+        save_every: int = 1000,
+    ):
+        self.directory = os.path.abspath(directory)
+        self.save_every = save_every
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save,
+        )
+        self._mngr = ocp.CheckpointManager(self.directory, options=options)
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
+        if not force and (self.save_every <= 0 or step % self.save_every != 0):
+            return False
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        return True
+
+    def restore(self, abstract_state: Any, step: Optional[int] = None) -> Any:
+        """Restore at ``step`` (default latest) into the sharding/dtype layout
+        described by ``abstract_state`` (jax.ShapeDtypeStruct tree with
+        shardings attached)."""
+        step = self.latest_step if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        return self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state)
+        )
+
+    def wait(self):
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+
+def abstract_like(state: Any) -> Any:
+    """ShapeDtypeStruct tree (with shardings) describing ``state``."""
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+
+    return jax.tree.map(leaf, state)
+
+
+__all__ = ["Checkpointer", "abstract_like"]

@@ -1,0 +1,166 @@
+"""Data pipeline: token-bin datasets, deterministic window sampling,
+threaded host→device prefetch.
+
+The reference feeds C4/WikiText-2 through a C++ dataset/loader
+(BASELINE.json; reference checkout never mounted — SURVEY.md §0). Here the
+on-disk format is a flat binary of token ids (uint16/uint32) with a JSON
+sidecar (``<name>.meta.json``: {"dtype", "count", "vocab_size"}), mmap'd on
+the host. Sampling is a pure function of (seed, step) — resuming at step N
+reproduces the exact batch sequence with no iterator state to checkpoint.
+A background thread overlaps host batch assembly + ``jax.device_put`` with
+the device step. ``orion_tpu/runtime/`` provides the C++ fast path for
+assembly; this module is the always-available fallback with the same
+format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+
+Array = jax.Array
+
+
+def write_token_bin(path: str, tokens: np.ndarray, vocab_size: int) -> None:
+    """Write the token-bin format (+ sidecar)."""
+    dtype = np.uint16 if vocab_size <= 65536 else np.uint32
+    arr = np.asarray(tokens, dtype=dtype)
+    arr.tofile(path)
+    with open(path + ".meta.json", "w") as f:
+        json.dump(
+            {"dtype": str(dtype.__name__ if hasattr(dtype, '__name__') else np.dtype(dtype).name),
+             "count": int(arr.size), "vocab_size": int(vocab_size)},
+            f,
+        )
+
+
+class TokenBinDataset:
+    """mmap'd flat token file; windows of seq_len+1 sampled deterministically."""
+
+    def __init__(self, path: str, seq_len: int):
+        meta_path = path + ".meta.json"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            dtype = np.dtype(meta["dtype"])
+            self.vocab_size = int(meta.get("vocab_size", np.iinfo(dtype).max + 1))
+        else:
+            dtype = np.dtype(np.uint16)
+            self.vocab_size = 65536
+        self.tokens = np.memmap(path, dtype=dtype, mode="r")
+        self.seq_len = seq_len
+        self.n_windows = len(self.tokens) - seq_len - 1
+        assert self.n_windows > 0, f"{path}: too few tokens for seq_len={seq_len}"
+
+    def batch(self, seed: int, step: int, batch_size: int) -> np.ndarray:
+        """[B, seq_len+1] int32; pure function of (seed, step)."""
+        rng = np.random.Generator(np.random.Philox(key=seed, counter=step))
+        starts = rng.integers(0, self.n_windows, size=batch_size)
+        out = np.empty((batch_size, self.seq_len + 1), dtype=np.int32)
+        for i, s in enumerate(starts):
+            out[i] = self.tokens[s : s + self.seq_len + 1]
+        return out
+
+
+class SyntheticDataset:
+    """Deterministic pseudo-data with learnable structure (each token is a
+    fixed function of the previous two) so overfit/convergence tests have
+    signal; same ``batch(seed, step, b)`` interface as TokenBinDataset."""
+
+    def __init__(self, vocab_size: int, seq_len: int):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+
+    def batch(self, seed: int, step: int, batch_size: int) -> np.ndarray:
+        rng = np.random.Generator(np.random.Philox(key=seed, counter=step))
+        t = self.seq_len + 1
+        out = np.empty((batch_size, t), dtype=np.int32)
+        out[:, 0] = rng.integers(0, self.vocab_size, size=batch_size)
+        out[:, 1] = rng.integers(0, self.vocab_size, size=batch_size)
+        for j in range(2, t):
+            out[:, j] = (out[:, j - 1] * 31 + out[:, j - 2] * 7 + 3) % self.vocab_size
+        return out
+
+
+class DataLoader:
+    """Background-thread prefetch: dataset.batch → device_put with the batch
+    sharding, ``prefetch`` batches deep. Restart-safe: construction takes the
+    starting step, and batches are pure functions of (seed, step)."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        seed: int = 0,
+        start_step: int = 0,
+        sharding=None,
+        prefetch: int = 2,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.seed = seed
+        self.step = start_step
+        self.sharding = sharding
+        self._q: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        step = self.step
+        while not self._stop.is_set():
+            host = self.dataset.batch(self.seed, step, self.batch_size)
+            if self.sharding is not None:
+                batch = jax.device_put(host, self.sharding)
+            else:
+                batch = jax.device_put(host)
+            # block while the queue is full, but wake up on stop
+            while not self._stop.is_set():
+                try:
+                    self._q.put(batch, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            step += 1
+
+    def __iter__(self) -> Iterator[Array]:
+        return self
+
+    def __next__(self) -> Array:
+        while True:
+            try:
+                return self._q.get(timeout=1.0)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    raise RuntimeError("data prefetch thread died")
+
+    def close(self):
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+def make_dataset(spec: str, seq_len: int, vocab_size: Optional[int] = None):
+    """'synthetic' or a token-bin path."""
+    if spec == "synthetic":
+        return SyntheticDataset(vocab_size or 256, seq_len)
+    return TokenBinDataset(spec, seq_len)
+
+
+__all__ = [
+    "TokenBinDataset",
+    "SyntheticDataset",
+    "DataLoader",
+    "write_token_bin",
+    "make_dataset",
+]

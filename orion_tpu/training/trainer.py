@@ -1,0 +1,321 @@
+"""Trainer: jitted sharded train step, AdamW/Lion, warmup+cosine schedules,
+grad accumulation, bf16 policy, NaN/Inf guard, eval loop (SURVEY.md T2/T3/
+T7/A2).
+
+The reference's torch training loop + NCCL DDP wrapper (BASELINE.json;
+reference checkout never mounted — SURVEY.md §0) becomes: one TrainState
+pytree sharded over the (dp, fsdp, tp, sp) mesh by path-based rules
+(parallel/sharding.py — the same rules cover optimizer moments, whose tree
+paths end in the param path), and one jitted step function; GSPMD inserts
+every collective. Mixed precision is structural: params fp32, activations
+bf16 (model cfg.dtype), logits + loss + grads fp32 master.
+
+Failure detection (A2): each step computes finite = isfinite(loss) &
+isfinite(grad_norm); on a bad step the update is skipped tree-wide
+(params/opt state keep their old values) and ``nonfinite`` counts it.
+``nan_policy="halt"`` makes the host loop raise instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from orion_tpu.models.configs import ModelConfig
+from orion_tpu.models.transformer import TransformerLM
+from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+from orion_tpu.parallel.sharding import batch_sharding, param_shardings
+from orion_tpu.utils import rng as rngs
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    model: ModelConfig = ModelConfig()
+    steps: int = 1000
+    batch_size: int = 8  # global
+    seq_len: int = 256
+    # optimizer
+    optimizer: str = "adamw"  # "adamw" | "lion"
+    lr: float = 3e-4
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.1
+    clip_norm: float = 1.0
+    accum_steps: int = 1
+    # schedule
+    schedule: str = "cosine"  # "cosine" | "linear" | "constant"
+    warmup_steps: int = 100
+    min_lr_ratio: float = 0.1
+    # parallelism
+    mesh: MeshConfig = MeshConfig()
+    # bookkeeping
+    seed: int = 0
+    log_every: int = 10
+    eval_every: int = 0
+    eval_batches: int = 8
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 1000
+    ckpt_keep: int = 3
+    nan_policy: str = "skip"  # "skip" | "halt"
+
+    @property
+    def micro_batch(self) -> int:
+        assert self.batch_size % self.accum_steps == 0
+        return self.batch_size // self.accum_steps
+
+
+class TrainState(struct.PyTreeNode):
+    step: Array
+    params: Any
+    opt_state: Any
+    rng: Array
+
+
+def make_schedule(cfg: TrainConfig):
+    peak, warm = cfg.lr, max(cfg.warmup_steps, 1)
+    floor = cfg.lr * cfg.min_lr_ratio
+    decay_steps = max(cfg.steps - warm, 1)
+    if cfg.schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            0.0, peak, warm, warm + decay_steps, end_value=floor
+        )
+    if cfg.schedule == "linear":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, peak, warm),
+                optax.linear_schedule(peak, floor, decay_steps),
+            ],
+            [warm],
+        )
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, peak, warm), optax.constant_schedule(peak)],
+        [warm],
+    )
+
+
+def _wd_mask(params: Any) -> Any:
+    """Decay only matrix params; skip norms/biases/scalars and the fixed
+    FAVOR+ projection (its grads are stop_gradient'd — decay would shrink
+    it to zero)."""
+
+    def mask(path, leaf):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        return leaf.ndim >= 2 and "favor_proj" not in name
+
+    return jax.tree_util.tree_map_with_path(mask, params)
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    sched = make_schedule(cfg)
+    if cfg.optimizer == "adamw":
+        opt = optax.adamw(
+            sched, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+            weight_decay=cfg.weight_decay, mask=_wd_mask,
+        )
+    elif cfg.optimizer == "lion":
+        opt = optax.lion(
+            sched, b1=cfg.b1, b2=cfg.b2,
+            weight_decay=cfg.weight_decay, mask=_wd_mask,
+        )
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    chain = [opt]
+    if cfg.clip_norm and cfg.clip_norm > 0:
+        chain.insert(0, optax.clip_by_global_norm(cfg.clip_norm))
+    return optax.chain(*chain)
+
+
+def lm_loss(model: TransformerLM, params, batch: Array, dropout_rng=None):
+    """batch [B, T+1] -> mean next-token cross entropy (fp32)."""
+    x, y = batch[:, :-1], batch[:, 1:]
+    kwargs = {}
+    if dropout_rng is not None:
+        kwargs = {"rngs": {"dropout": dropout_rng}, "deterministic": False}
+    logits = model.apply(params, x, **kwargs)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+    return losses.mean()
+
+
+class Trainer:
+    def __init__(self, cfg: TrainConfig, mesh: Optional[Mesh] = None):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+        self.model = TransformerLM(cfg.model)
+        self.tx = make_optimizer(cfg)
+        self.sched = make_schedule(cfg)
+        self.batch_shd = batch_sharding(self.mesh)
+
+        root = rngs.root_key(cfg.seed)
+        self._init_rng = rngs.stream(root, "init")
+        self._dropout_rng = rngs.stream(root, "dropout")
+
+        sample_tokens = jnp.zeros((1, cfg.seq_len), jnp.int32)
+
+        def init_fn(rng):
+            params = self.model.init(rng, sample_tokens)
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=self.tx.init(params),
+                rng=self._dropout_rng,
+            )
+
+        abstract = jax.eval_shape(init_fn, self._init_rng)
+        # one rule set shards the whole state: optimizer-moment paths end in
+        # the same 'wq/kernel'-style suffixes the param rules match on
+        self.state_shardings = param_shardings(abstract, self.mesh)
+        self.state = jax.jit(init_fn, out_shardings=self.state_shardings)(
+            self._init_rng
+        )
+
+        self._step_fn = jax.jit(
+            self._train_step,
+            donate_argnums=(0,),
+            in_shardings=(self.state_shardings, self.batch_shd),
+            out_shardings=(self.state_shardings, None),
+        )
+        self._eval_fn = jax.jit(
+            self._eval_step, in_shardings=(self.state_shardings.params, self.batch_shd)
+        )
+        self.nonfinite_steps = 0
+
+    # -- jitted bodies ------------------------------------------------------
+
+    def _train_step(
+        self, state: TrainState, batch: Array
+    ) -> Tuple[TrainState, Dict[str, Array]]:
+        cfg = self.cfg
+        use_dropout = cfg.model.dropout > 0.0
+        step_rng = rngs.at_step(state.rng, state.step)
+
+        def loss_for(params, b, r):
+            return lm_loss(self.model, params, b, r if use_dropout else None)
+
+        grad_fn = jax.value_and_grad(loss_for)
+
+        if cfg.accum_steps == 1:
+            loss, grads = grad_fn(state.params, batch, step_rng)
+        else:
+            micro = batch.reshape(cfg.accum_steps, cfg.micro_batch, -1)
+
+            def body(carry, mb_i):
+                acc_loss, acc_grads, i = carry
+                r = jax.random.fold_in(step_rng, i)
+                l, g = grad_fn(state.params, mb_i, r)
+                acc = jax.tree.map(jnp.add, acc_grads, g)
+                return (acc_loss + l, acc, i + 1), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (loss, grads, _), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zeros, jnp.zeros((), jnp.int32)),
+                micro,
+            )
+            loss = loss / cfg.accum_steps
+            grads = jax.tree.map(lambda g: g / cfg.accum_steps, grads)
+
+        gnorm = optax.global_norm(grads)
+        finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+
+        safe_grads = jax.tree.map(lambda g: jnp.where(finite, g, 0.0), grads)
+        updates, new_opt = self.tx.update(
+            safe_grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        # skip-policy: on a non-finite step keep the old params & opt state
+        sel = lambda new, old: jax.tree.map(  # noqa: E731
+            lambda n, o: jnp.where(finite, n, o), new, old
+        )
+        new_state = TrainState(
+            step=state.step + 1,
+            params=sel(new_params, state.params),
+            opt_state=sel(new_opt, state.opt_state),
+            rng=state.rng,
+        )
+        metrics = {
+            "loss": loss,
+            "grad_norm": gnorm,
+            "lr": self.sched(state.step),
+            "nonfinite": (~finite).astype(jnp.int32),
+        }
+        return new_state, metrics
+
+    def _eval_step(self, params, batch: Array) -> Tuple[Array, Array]:
+        x, y = batch[:, :-1], batch[:, 1:]
+        logits = self.model.apply(params, x)
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        return losses.sum(), jnp.asarray(losses.size, jnp.float32)
+
+    # -- host API -----------------------------------------------------------
+
+    def step(self, batch: Array) -> Dict[str, float]:
+        self.state, metrics = self._step_fn(self.state, batch)
+        return metrics
+
+    def train(self, data_iter, logger=None, ckpt=None, hook=None) -> Dict[str, float]:
+        """Run cfg.steps - state.step steps. Returns last metrics (host)."""
+        cfg = self.cfg
+        tokens_per_step = cfg.batch_size * cfg.seq_len
+        last: Dict[str, float] = {}
+        start_step = int(self.state.step)
+        for step in range(start_step + 1, cfg.steps + 1):
+            batch = next(data_iter)
+            metrics = self.step(batch)
+            # only materialize metrics on the host at log cadence — reading a
+            # device scalar every step would serialize the pipeline
+            if step % cfg.log_every == 0 or step == cfg.steps:
+                if metrics["nonfinite"]:
+                    self.nonfinite_steps += int(metrics["nonfinite"])
+                    if cfg.nan_policy == "halt":
+                        raise FloatingPointError(
+                            f"non-finite loss/grads at step {step}"
+                        )
+                last = {k: float(v) for k, v in metrics.items()}
+                last["ppl"] = float(jnp.exp(jnp.minimum(last["loss"], 20.0)))
+                if logger:
+                    logger.log(step, last, tokens_per_step)
+            if ckpt is not None:
+                ckpt.maybe_save(step, self.state)
+            if hook is not None:
+                hook(step, metrics)
+        if not last and start_step < cfg.steps:
+            last = {k: float(v) for k, v in metrics.items()}
+        return last
+
+    def evaluate(self, data_iter, n_batches: Optional[int] = None) -> Dict[str, float]:
+        n = n_batches or self.cfg.eval_batches
+        total, count = 0.0, 0.0
+        for _ in range(n):
+            batch = next(data_iter)
+            s, c = self._eval_fn(self.state.params, batch)
+            total += float(s)
+            count += float(c)
+        loss = total / max(count, 1.0)
+        return {"eval_loss": loss, "eval_ppl": float(jnp.exp(jnp.minimum(loss, 20.0)))}
+
+    # -- checkpoint glue ----------------------------------------------------
+
+    def abstract_state(self):
+        def leaf(s, shd):
+            return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=shd)
+
+        return jax.tree.map(leaf, self.state, self.state_shardings)
+
+    def restore(self, ckpt, step: Optional[int] = None):
+        self.state = ckpt.restore(self.abstract_state(), step)
+        return int(self.state.step)
+
+
+__all__ = ["Trainer", "TrainConfig", "TrainState", "lm_loss", "make_optimizer"]

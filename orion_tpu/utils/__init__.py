@@ -1,0 +1,1 @@
+"""Utilities: deterministic RNG threading, config plumbing, profiling."""

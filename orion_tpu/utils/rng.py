@@ -1,0 +1,35 @@
+"""Deterministic seeding (SURVEY.md A4): one root key per run, everything
+else derived by named fold-ins — init, dropout, data sampling, and decode
+sampling never share streams. The reference relies on torch's global seed
+state; JAX's explicit keys make the threading auditable and the runs
+bitwise-reproducible (with jax_threefry_partitionable for sharded dropout).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_STREAMS = ("init", "dropout", "data", "sample", "eval")
+
+
+def root_key(seed: int) -> Array:
+    return jax.random.PRNGKey(seed)
+
+
+def stream(key: Array, name: str) -> Array:
+    """Named substream: fold in a stable hash of the name."""
+    h = int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little")
+    return jax.random.fold_in(key, h)
+
+
+def at_step(key: Array, step) -> Array:
+    """Per-step key (step may be traced)."""
+    return jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
+
+
+__all__ = ["root_key", "stream", "at_step"]
