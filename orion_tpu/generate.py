@@ -1,0 +1,188 @@
+"""`python -m orion_tpu.generate` — recurrent O(1)-state autoregressive
+decode (SURVEY.md I1–I5).
+
+TPU-native counterpart of the reference's `orion.generate` (BASELINE.json
+"recurrent autoregressive decode (O(1) state)"; reference checkout never
+mounted — SURVEY.md §0). The pipeline:
+
+1. **prefill** — one jitted parallel forward over the prompt (chunked linear
+   attention / flash softmax), returning per-layer decode state: (S, z)
+   kv-cumsum states for linear layers, KV caches for softmax, ring-buffer
+   window caches for swa.
+2. **decode** — ONE jitted ``lax.scan`` over all steps (no per-step
+   retrace/dispatch): carry = (token, states, rng, t); body = embed →
+   per-layer recurrent_step / cache-append attention → logits → sample.
+   Linear-layer memory stays O(Dk·Dv) per head regardless of length.
+3. **sampling** — greedy / temperature / top-k / top-p, batched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.models.configs import ModelConfig, get_config
+from orion_tpu.models.transformer import TransformerLM, init_decode_state
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleConfig:
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = off
+    top_p: float = 1.0  # 1.0 = off
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+def sample_logits(logits: Array, rng: Array, cfg: SampleConfig) -> Array:
+    """logits [B, V] -> token ids [B]."""
+    if cfg.greedy:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / cfg.temperature
+    if cfg.top_k and cfg.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -cfg.top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if cfg.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p (always
+        # keeps the argmax); cutoff = lowest logit inside that prefix
+        keep = cum - probs < cfg.top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+@partial(jax.jit, static_argnums=(0, 3, 4))
+def _generate_jit(
+    model: TransformerLM,
+    params: Any,
+    prompt: Array,
+    max_new_tokens: int,
+    sample_cfg: SampleConfig,
+    rng: Array,
+) -> Array:
+    """prompt [B, T0] -> generated [B, max_new_tokens]."""
+    t0 = prompt.shape[1]
+    logits, states = model.apply(params, prompt, method="prefill")
+    first = sample_logits(logits[:, -1], jax.random.fold_in(rng, 0), sample_cfg)
+
+    def body(carry, i):
+        token, states, t = carry
+        logits, states = model.apply(params, token, states, t, method="decode_step")
+        nxt = sample_logits(logits, jax.random.fold_in(rng, i + 1), sample_cfg)
+        return (nxt, states, t + 1), token
+
+    (_, _, _), tokens = jax.lax.scan(
+        body,
+        (first, states, jnp.int32(t0)),
+        jnp.arange(max_new_tokens),
+        length=max_new_tokens,
+    )
+    return jnp.moveaxis(tokens, 0, 1)  # [B, N]
+
+
+def generate(
+    model: TransformerLM,
+    params: Any,
+    prompt: Array,
+    max_new_tokens: int,
+    sample: Optional[SampleConfig] = None,
+    rng: Optional[Array] = None,
+) -> Array:
+    """Batched generation; one compile per (prompt_len, max_new_tokens)."""
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    cap = model.cfg.max_seq_len
+    assert prompt.shape[1] + max_new_tokens <= cap, (
+        f"prompt {prompt.shape[1]} + new {max_new_tokens} exceeds max_seq_len {cap}"
+    )
+    return _generate_jit(
+        model,
+        params,
+        jnp.asarray(prompt, jnp.int32),
+        int(max_new_tokens),
+        sample or SampleConfig(),
+        rng if rng is not None else jax.random.PRNGKey(0),
+    )
+
+
+def generate_unconditional(
+    model: TransformerLM,
+    params: Any,
+    batch_size: int,
+    max_new_tokens: int,
+    bos_token: int = 0,
+    **kw,
+) -> Array:
+    prompt = jnp.full((batch_size, 1), bos_token, jnp.int32)
+    return generate(model, params, prompt, max_new_tokens, **kw)
+
+
+def load_params(ckpt_dir: str, step: Optional[int] = None) -> Tuple[Any, int]:
+    """Pull just the params subtree out of a training checkpoint."""
+    import orbax.checkpoint as ocp
+
+    mngr = ocp.CheckpointManager(ckpt_dir)
+    step = mngr.latest_step() if step is None else step
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint in {ckpt_dir}")
+    restored = mngr.restore(step)
+    mngr.close()
+    params = restored["params"]
+    return params, step
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("orion_tpu.generate")
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--ckpt-dir", required=False, default=None)
+    p.add_argument("--prompt", default="Hello")
+    p.add_argument("--max-new-tokens", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    from orion_tpu.utils.tokenizer import ByteTokenizer
+
+    cfg = get_config(args.config)
+    model = TransformerLM(cfg)
+    tok = ByteTokenizer()
+    prompt = jnp.asarray([tok.encode(args.prompt)], jnp.int32)
+
+    if args.ckpt_dir:
+        params, step = load_params(args.ckpt_dir)
+        print(f"loaded step {step} from {args.ckpt_dir}", file=sys.stderr)
+    else:
+        params = model.init(jax.random.PRNGKey(0), prompt)
+        print("no --ckpt-dir: random params (smoke test)", file=sys.stderr)
+
+    out = generate(
+        model,
+        params,
+        prompt,
+        args.max_new_tokens,
+        SampleConfig(args.temperature, args.top_k, args.top_p),
+        jax.random.PRNGKey(args.seed),
+    )
+    print(args.prompt + tok.decode([int(t) for t in out[0]]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+"""Byte-level tokenizer (Python path; runtime/ has the C++ encode hot path).
+
+The reference ships a tokenizer in its native extension layer
+(BASELINE.json; reference checkout never mounted — SURVEY.md §0). Vocab:
+ids 0..255 = raw bytes; optional specials appended after. This is the
+fallback used whenever the C++ runtime .so is absent — identical output by
+construction (both map bytes→ids 1:1), asserted in tests/test_runtime.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class ByteTokenizer:
+    BOS = 256
+    EOS = 257
+
+    def __init__(self, add_specials: bool = False):
+        self.add_specials = add_specials
+
+    @property
+    def vocab_size(self) -> int:
+        return 258 if self.add_specials else 256
+
+    def encode(self, text: str) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if self.add_specials:
+            return [self.BOS] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+__all__ = ["ByteTokenizer"]
