@@ -1,0 +1,72 @@
+"""Named-axis collective wrappers (SURVEY.md P8).
+
+The vocabulary the reference speaks in NCCL (allreduce / allgather /
+reduce_scatter / sendrecv; BASELINE.json NCCL DP wrapper — reference
+checkout never mounted, SURVEY.md §0), expressed as XLA collectives over
+mesh axes. These are used *inside* ``shard_map`` bodies (sequence.py,
+ring.py); the GSPMD training path never calls them directly — jit inserts
+its own from shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+from jax import lax
+
+Array = jax.Array
+Axis = Union[str, tuple]
+
+
+def psum(x: Array, axis: Axis) -> Array:
+    return lax.psum(x, axis)
+
+
+def pmean(x: Array, axis: Axis) -> Array:
+    return lax.pmean(x, axis)
+
+
+def pmax(x: Array, axis: Axis) -> Array:
+    return lax.pmax(x, axis)
+
+
+def all_gather(x: Array, axis: Axis, *, gather_axis: int = 0, tiled: bool = False) -> Array:
+    """Gather shards along ``gather_axis`` (new leading dim if tiled=False)."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x: Array, axis: Axis, *, scatter_axis: int = 0) -> Array:
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ppermute_shift(x: Array, axis: str, shift: int = 1) -> Array:
+    """Rotate shards around the ring: device i -> device (i+shift) % n."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x: Array, axis: str, *, split_axis: int, concat_axis: int) -> Array:
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+def axis_index(axis: str) -> Array:
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return lax.axis_size(axis)
+
+
+__all__ = [
+    "psum",
+    "pmean",
+    "pmax",
+    "all_gather",
+    "reduce_scatter",
+    "ppermute_shift",
+    "all_to_all",
+    "axis_index",
+    "axis_size",
+]

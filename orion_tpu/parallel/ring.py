@@ -1,0 +1,135 @@
+"""Ring attention: exact softmax attention over sp-sharded sequences
+(SURVEY.md P6).
+
+Long-context softmax layers can't use the kv-cumsum trick — the keys
+themselves must visit every query. Ring attention streams them: each sp
+shard holds its local Q and rotates the (K, V) block around the ring via
+``ppermute`` (neighbor-to-neighbor over ICI — the TPU-native form of the
+reference's long-context NCCL path; reference checkout never mounted —
+SURVEY.md §0), folding each incoming block into a running online-softmax
+accumulator (m, l, acc) — flash attention with the block loop unrolled
+across chips, compute and ICI transfers overlapping.
+
+Causal masking by block index: an incoming block j (vs my index i) is
+fully visible if j < i, diagonal (intra-block causal) if j == i, and
+skipped if j > i — skipped blocks still rotate (the ring must complete)
+but contribute zero compute via ``lax.cond``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+Array = jax.Array
+
+_NEG = -1e30
+
+
+def _block_attend(q, k, v, m, l, acc, scale, mask):
+    """Fold one (K, V) block into the online-softmax accumulator."""
+    s = jnp.einsum(
+        "...td,...sd->...ts", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum("...ts,...sd->...td", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def ring_attention_local(
+    q: Array,
+    k: Array,
+    v: Array,
+    axis: str = "sp",
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> Array:
+    """shard_map body: q,k,v LOCAL [..., T/sp, D] shards; exact softmax
+    attention over the full (global) sequence."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    t_loc = q.shape[-2]
+
+    row = jnp.arange(t_loc)[:, None]
+    col = jnp.arange(t_loc)[None, :]
+    diag_mask = row >= col  # intra-block causal
+
+    # derive initializers from q so they carry the same device-varying type
+    # as the loop-body outputs (shard_map vma rules for lax.cond branches)
+    zq = q[..., :1].astype(jnp.float32) * 0.0
+    m0 = zq + _NEG
+    l0 = zq
+    acc0 = zq * jnp.zeros((v.shape[-1],), jnp.float32)
+
+    def body(step, carry):
+        k_blk, v_blk, m, l, acc = carry
+        j = (i - step) % n  # origin shard of the block currently held
+
+        def attend_full(args):
+            m, l, acc = args
+            return _block_attend(q, k_blk, v_blk, m, l, acc, scale, None)
+
+        def attend_diag(args):
+            m, l, acc = args
+            return _block_attend(q, k_blk, v_blk, m, l, acc, scale, diag_mask)
+
+        def skip(args):
+            return args
+
+        if causal:
+            m, l, acc = lax.cond(
+                j < i,
+                attend_full,
+                lambda args: lax.cond(j == i, attend_diag, skip, args),
+                (m, l, acc),
+            )
+        else:
+            m, l, acc = attend_full((m, l, acc))
+
+        # rotate kv to the next device; after n-1 steps every block visited
+        perm = [(d, (d + 1) % n) for d in range(n)]
+        k_nxt = lax.ppermute(k_blk, axis, perm)
+        v_nxt = lax.ppermute(v_blk, axis, perm)
+        return k_nxt, v_nxt, m, l, acc
+
+    _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe).astype(q.dtype)
+
+
+def ring_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    mesh: Mesh,
+    *,
+    axis: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> Array:
+    """Global entry: q,k,v [B, H, T, D] with T sharded over ``axis``."""
+    spec = P(("dp", "fsdp"), "tp", axis, None)
+    fn = shard_map(
+        partial(ring_attention_local, axis=axis, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+__all__ = ["ring_attention", "ring_attention_local"]

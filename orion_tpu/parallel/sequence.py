@@ -1,0 +1,105 @@
+"""Sequence/context-parallel causal linear attention (SURVEY.md P5).
+
+Long-context support for linear-attention layers: tokens sharded over the
+``sp`` mesh axis. The linear-attention recurrence makes this almost free —
+unlike softmax, the cross-shard information is a single [Dk, Dv] kv-cumsum
+state per head, not the keys themselves (the reference scales long context
+through its CUDA kv-cumsum kernel + NCCL; reference checkout never mounted
+— SURVEY.md §0). Per sp shard i:
+
+    1. local chunked causal attention with carried state → out_i needs
+       S_prefix_i = Σ_{j<i} S_j   (and z_prefix_i = Σ_{j<i} z_j)
+    2. all_gather of the tiny per-shard states (Dk×Dv per head — bytes,
+       not activations) over sp; exclusive prefix = masked sum over j < i
+    3. re-run local attention seeded with initial_state=S_prefix_i
+       (exact: the chunked kernel supports a carried-in state)
+
+Communication: one all_gather of [sp, B, H, Dk, Dv] per layer — O(D²)
+bytes over ICI, independent of sequence length. Differentiable end-to-end
+(the Pallas kernel's custom VJP handles d/d(initial_state)).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from orion_tpu.ops.dispatch import causal_dot_product
+
+Array = jax.Array
+
+
+def _local_states(k: Array, v: Array) -> Tuple[Array, Array]:
+    kf, vf = k.astype(jnp.float32), v.astype(jnp.float32)
+    s = jnp.einsum("...td,...te->...de", kf, vf)
+    z = jnp.sum(kf, axis=-2)
+    return s, z
+
+
+def _exclusive_prefix(x_local: Array, axis: str) -> Array:
+    """Σ over shards j < my_index of per-shard reductions. all_gather the
+    tiny tensors, then a masked sum (sp is small; O(sp) memory is nothing)."""
+    gathered = lax.all_gather(x_local, axis)  # [sp, ...]
+    n = gathered.shape[0]
+    idx = lax.axis_index(axis)
+    mask = (jnp.arange(n) < idx).astype(gathered.dtype)
+    mask = mask.reshape((n,) + (1,) * (gathered.ndim - 1))
+    return jnp.sum(gathered * mask, axis=0)
+
+
+def sp_linear_attention_local(
+    q: Array,
+    k: Array,
+    v: Array,
+    axis: str = "sp",
+    *,
+    backend: str = "auto",
+    chunk: int = 128,
+    eps: float = 1e-6,
+) -> Array:
+    """The shard_map body: q,k,v are the LOCAL [.., T/sp, D] shards (post
+    feature map). Normalized causal linear attention, exact across shards."""
+    s_loc, z_loc = _local_states(k, v)
+    s0 = _exclusive_prefix(s_loc, axis)
+    z0 = _exclusive_prefix(z_loc, axis)
+
+    num = causal_dot_product(
+        q, k, v, backend=backend, chunk=chunk, initial_state=s0
+    )
+    kf = k.astype(jnp.float32)
+    zcum = jnp.cumsum(kf, axis=-2) + z0[..., None, :]
+    den = jnp.einsum("...td,...td->...t", q.astype(jnp.float32), zcum)
+    return (num.astype(jnp.float32) / (den[..., None] + eps)).astype(q.dtype)
+
+
+def sp_linear_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    mesh: Mesh,
+    *,
+    axis: str = "sp",
+    backend: str = "auto",
+    chunk: int = 128,
+) -> Array:
+    """Global entry: q,k,v [B, H, T, D] with T sharded over ``axis``.
+    Batch rides on (dp, fsdp); heads on tp."""
+    spec = P(("dp", "fsdp"), "tp", axis, None)
+    fn = shard_map(
+        partial(
+            sp_linear_attention_local, axis=axis, backend=backend, chunk=chunk
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+__all__ = ["sp_linear_attention", "sp_linear_attention_local"]
