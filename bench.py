@@ -1,0 +1,143 @@
+"""Benchmark harness: tokens/sec/chip on the 1.3B linear-attn LM train step
+(the BASELINE.json metric), on whatever single chip is available.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` is the ratio against BENCH_BASELINE.json (the first recorded
+round-1 number — BASELINE.json.published was empty and the reference
+checkout was never mounted, so there is no reference number to compare to;
+see BASELINE.md). Ratio > 1.0 = faster than round 1.
+
+A recurrent-decode latency figure (the second BASELINE.json metric) is
+printed to stderr alongside, not as the headline line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+
+def _build(batch_size: int, seq_len: int):
+    import jax.numpy as jnp
+
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.parallel.mesh import MeshConfig
+    from orion_tpu.training.data import SyntheticDataset
+    from orion_tpu.training.trainer import TrainConfig, Trainer
+
+    model = dataclasses.replace(
+        get_config("lm_1b3"), max_seq_len=seq_len, remat=True
+    )
+    cfg = TrainConfig(
+        model=model,
+        steps=10**9,
+        batch_size=batch_size,
+        seq_len=seq_len,
+        optimizer="lion",      # one moment: the 1.3B step fits in 16GB HBM
+        mu_dtype="bfloat16",
+        lr=1e-4,
+        warmup_steps=10,
+        mesh=MeshConfig(dp=1),
+        log_every=10**9,
+    )
+    trainer = Trainer(cfg)
+    batch = jnp.asarray(
+        SyntheticDataset(model.vocab_size, seq_len).batch(0, 0, batch_size)
+    )
+    return trainer, batch
+
+
+def bench_train(seq_len: int = 2048, iters: int = 10) -> dict:
+    import jax
+
+    last_err = None
+    for batch_size in (8, 4, 2, 1):
+        try:
+            trainer, batch = _build(batch_size, seq_len)
+            trainer.step(batch)  # compile + 1 step
+            trainer.step(batch)  # warm
+            jax.block_until_ready(trainer.state.params)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                trainer.step(batch)
+            jax.block_until_ready(trainer.state.params)
+            dt = time.perf_counter() - t0
+            toks = batch_size * seq_len * iters / dt
+            return {
+                "tokens_per_sec": toks,
+                "batch_size": batch_size,
+                "seq_len": seq_len,
+                "step_ms": 1000 * dt / iters,
+            }
+        except Exception as e:  # OOM at this batch size -> halve
+            last_err = e
+            if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" not in str(e):
+                raise
+    raise RuntimeError(f"all batch sizes OOM'd: {last_err}")
+
+
+def bench_decode(n_tokens: int = 64) -> float:
+    """p50 per-token latency (ms) of recurrent decode on the tiny config."""
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import SampleConfig, generate
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+
+    cfg = get_config("tiny")
+    model = TransformerLM(cfg)
+    prompt = jnp.ones((1, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    sample = SampleConfig(temperature=0.0)
+    generate(model, params, prompt, n_tokens, sample)  # compile
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(generate(model, params, prompt, n_tokens, sample))
+        times.append((time.perf_counter() - t0) / n_tokens * 1000)
+    return sorted(times)[len(times) // 2]
+
+
+def main() -> int:
+    res = bench_train()
+    try:
+        decode_ms = bench_decode()
+        print(
+            json.dumps({"decode_p50_ms_per_token_tiny": round(decode_ms, 4)}),
+            file=sys.stderr,
+        )
+    except Exception as e:
+        print(f"decode bench failed: {e}", file=sys.stderr)
+
+    baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f).get("tokens_per_sec")
+        if base:
+            vs = res["tokens_per_sec"] / base
+    print(
+        json.dumps(
+            {
+                "metric": "train_tokens_per_sec_per_chip_lm1b3",
+                "value": round(res["tokens_per_sec"], 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+    print(
+        json.dumps({"detail": res}),
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,15 +9,16 @@ matrix, accumulates in fp32 VMEM scratch.
 Forward:  grid (B·H, Tq/Bq, Tk/Bk), k-axis innermost (sequential on a TPU
 core), scratch carries the running row-max m, row-sum l, and output
 accumulator; finalized on the last k-block. Saves the log-sum-exp for the
-backward. Fully-masked (q-block, k-block) tiles skip all compute via
-``pl.when`` on the block indices.
+backward as a [B·H, T, 1] column (the trailing unit dim keeps the block
+shape legal under TPU (8,128) tiling).
 
 Backward (custom VJP, two kernels — the standard flash decomposition):
     delta = rowsum(dO ⊙ O)                       (XLA, one fused reduce)
-    dQ kernel: grid (B·H, Tq/Bq, Tk/Bk):  P = exp(S − lse);
+    dQ kernel (grid B·H × Tq/Bq × Tk/Bk):  P = exp(S − lse);
         dS = P ⊙ (dO Vᵀ − delta);  dQ += dS K · scale
-    dK/dV kernel: grid (B·H, Tk/Bk, Tq/Bq):  Pᵀ on the transposed tile;
-        dV += Pᵀ dO;  dK += dSᵀ Q · scale
+    dK/dV kernel (grid B·H × Tk/Bk × Tq/Bq): same q-major (Bq, Bk) tile
+        orientation — PᵀdO and dSᵀQ come out of dot_general by contracting
+        the q dim, so no in-kernel transposes;  dV += PᵀdO;  dK += dSᵀQ·scale
 Both recompute P from (q, k, lse) — O(T) memory, matmuls on the MXU.
 
 ``window=w`` = each query sees keys s ∈ (t−w, t]. Masks are structural
@@ -60,6 +61,12 @@ def _skip_tile(qi, ki, bq, bk, causal, window):
     return skip
 
 
+def _rowscol(qi, ki, bq, bk):
+    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return rows, cols
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -84,8 +91,7 @@ def _fwd_kernel(
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (Bq, Bk)
-        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        rows, cols = _rowscol(qi, ki, bq, bk)
         s = jnp.where(_tile_mask(rows, cols, causal, window, t_k), s, _NEG)
 
         m_prev = m_scr[:]
@@ -103,7 +109,7 @@ def _fwd_kernel(
         l = l_scr[:]
         safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (padding) -> 0
         o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(safe))[:, 0]
+        lse_ref[0] = m_scr[:] + jnp.log(safe)  # (Bq, 1)
 
 
 def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret):
@@ -130,11 +136,11 @@ def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, dv), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, nq * bq, dv), q.dtype),
-            jax.ShapeDtypeStruct((bh, nq * bq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, nq * bq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -143,7 +149,7 @@ def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :t_q, :], lse[:, :t_q]
+    return out[:, :t_q, :], lse[:, :t_q, :]
 
 
 # ---------------------------------------------------------------------------
@@ -168,16 +174,15 @@ def _dq_kernel(
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        rows, cols = _rowscol(qi, ki, bq, bk)
         mask = _tile_mask(rows, cols, causal, window, t_k)
-        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)  # lse: (Bq, 1)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         dq_scr[:] = dq_scr[:] + jnp.dot(
             ds, k_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
         )
@@ -201,26 +206,30 @@ def _dkv_kernel(
 
     @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window)))
     def _():
-        st = jax.lax.dot_general(
-            k_ref[0], q_ref[0],
+        # q-major (Bq, Bk) tile; k-side grads via contraction over the q dim
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # (Bk, Bq) = transposed scores
-        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
-        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+        ) * scale
+        rows, cols = _rowscol(qi, ki, bq, bk)
         mask = _tile_mask(rows, cols, causal, window, t_k)
-        pt = jnp.where(mask, jnp.exp(st - lse_ref[0][None, :]), 0.0)
-        dv_scr[:] = dv_scr[:] + jnp.dot(
-            pt, do_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do_ref[0].astype(jnp.float32),
+            dimension_numbers=(((0,), (0,)), ((), ())),  # Pᵀ dO
+            preferred_element_type=jnp.float32,
         )
-        dpt = jax.lax.dot_general(
-            v_ref[0], do_ref[0],
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (Bk, Bq)
-        dst = pt * (dpt - delta_ref[0][None, :]) * scale
-        dk_scr[:] = dk_scr[:] + jnp.dot(
-            dst, q_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32),
+            dimension_numbers=(((0,), (0,)), ((), ())),  # dSᵀ Q
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(qi == nq - 1)
@@ -234,19 +243,22 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
     t_k = k.shape[1]
     dv = v.shape[-1]
     delta = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # (BH, Tq)
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )  # (BH, Tq, 1)
 
     pq, pk = (-t_q) % bq, (-t_k) % bk
     padq = lambda x: jnp.pad(x, ((0, 0), (0, pq), (0, 0))) if pq else x  # noqa: E731
     padk = lambda x: jnp.pad(x, ((0, 0), (0, pk), (0, 0))) if pk else x  # noqa: E731
-    pad1 = lambda x: jnp.pad(x, ((0, 0), (0, pq))) if pq else x  # noqa: E731
-    qp, kp, vp, gp = padq(q), padk(k), padk(v), padq(g)
-    # Padded query rows have lse=0 => p = exp(-1e30 * scale ... ) — ensure
-    # their P is zero via the t_k col mask plus a huge lse.
-    lsep = pad1(lse) if not pq else jnp.pad(lse, ((0, 0), (0, pq)), constant_values=jnp.inf)
-    deltap = pad1(delta)
+    qp, kp, vp, gp, deltap = padq(q), padk(k), padk(v), padq(g), padq(delta)
+    # padded query rows get lse=+inf so their recomputed P is exactly zero
+    lsep = (
+        jnp.pad(lse, ((0, 0), (0, pq), (0, 0)), constant_values=jnp.inf)
+        if pq
+        else lse
+    )
     nq, nk = qp.shape[1] // bq, kp.shape[1] // bk
+
+    col_spec_q = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
 
     dq_kern = functools.partial(
         _dq_kernel, scale=scale, causal=causal, window=window,
@@ -260,8 +272,8 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, dv), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, dv), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
+            col_spec_q,
+            col_spec_q,
         ],
         out_specs=pl.BlockSpec(
             (1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
@@ -271,6 +283,9 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
         interpret=interpret,
     )(qp, kp, vp, gp, lsep, deltap)
 
+    col_spec_q_inner = pl.BlockSpec(
+        (1, bq, 1), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM
+    )
     dkv_kern = functools.partial(
         _dkv_kernel, scale=scale, causal=causal, window=window,
         t_k=t_k, bq=bq, bk=bk, nq=nq,
@@ -283,8 +298,8 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, dv), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, dv), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i), memory_space=pltpu.VMEM),
+            col_spec_q_inner,
+            col_spec_q_inner,
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),

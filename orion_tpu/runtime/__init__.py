@@ -1,0 +1,169 @@
+"""Native runtime bindings (SURVEY.md N2): ctypes over liborion_runtime.so,
+with the pure-Python implementations as drop-in fallback.
+
+The .so is optional by design — every API here has a Python twin with the
+identical determinism contract (same splitmix64 window stream, same
+byte-level vocab), so the framework runs anywhere and the native path is a
+pure speedup. ``native_available()`` reports which path is live;
+``build()`` compiles the .so in-tree with g++.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_DIR, "liborion_runtime.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile liborion_runtime.so. Returns success."""
+    try:
+        subprocess.run(
+            ["sh", os.path.join(_DIR, "build.sh")],
+            check=True,
+            capture_output=quiet,
+        )
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO_PATH) and os.environ.get("ORION_TPU_BUILD_RUNTIME"):
+        build()
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    lib.orion_loader_open.restype = ctypes.c_void_p
+    lib.orion_loader_open.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+    lib.orion_loader_n_tokens.restype = ctypes.c_int64
+    lib.orion_loader_n_tokens.argtypes = [ctypes.c_void_p]
+    lib.orion_loader_batch.restype = None
+    lib.orion_loader_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+    ]
+    lib.orion_loader_close.restype = None
+    lib.orion_loader_close.argtypes = [ctypes.c_void_p]
+    lib.orion_byte_encode.restype = ctypes.c_int64
+    lib.orion_byte_encode.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.orion_byte_encode_file.restype = ctypes.c_int64
+    lib.orion_byte_encode_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeTokenBinDataset:
+    """C++ mmap+gather loader; same (seed, step) -> batch contract as the
+    Python TokenBinDataset (training/data.py). Raises ImportError when the
+    .so is missing — callers use ``make_fastest_dataset`` to auto-fallback."""
+
+    def __init__(self, path: str, seq_len: int, n_threads: int = 4):
+        lib = _load()
+        if lib is None:
+            raise ImportError("liborion_runtime.so not built (run runtime.build())")
+        meta_path = path + ".meta.json"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            dtype = np.dtype(meta["dtype"])
+            self.vocab_size = int(meta.get("vocab_size", np.iinfo(dtype).max + 1))
+        else:
+            dtype = np.dtype(np.uint16)
+            self.vocab_size = 65536
+        self._lib = lib
+        self._h = lib.orion_loader_open(
+            path.encode(), seq_len, int(dtype.itemsize)
+        )
+        if not self._h:
+            raise OSError(f"orion_loader_open failed for {path}")
+        self.seq_len = seq_len
+        self.n_threads = n_threads
+        self.n_tokens = lib.orion_loader_n_tokens(self._h)
+        self.n_windows = self.n_tokens - seq_len - 1
+
+    def batch(self, seed: int, step: int, batch_size: int) -> np.ndarray:
+        out = np.empty((batch_size, self.seq_len + 1), dtype=np.int32)
+        self._lib.orion_loader_batch(
+            self._h,
+            ctypes.c_uint64(seed),
+            ctypes.c_uint64(step),
+            batch_size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self.n_threads,
+        )
+        return out
+
+    def close(self):
+        if self._h:
+            self._lib.orion_loader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def make_fastest_dataset(path: str, seq_len: int):
+    """Native loader if the .so is present, Python mmap fallback otherwise."""
+    if native_available():
+        return NativeTokenBinDataset(path, seq_len)
+    from orion_tpu.training.data import TokenBinDataset
+
+    return TokenBinDataset(path, seq_len)
+
+
+def byte_encode_file(in_path: str, out_path: str) -> int:
+    """Stream a raw file into a uint16 token-bin (+ sidecar). Native if
+    available, Python otherwise. Returns token count."""
+    lib = _load()
+    if lib is not None:
+        n = lib.orion_byte_encode_file(in_path.encode(), out_path.encode())
+        if n < 0:
+            raise OSError(f"orion_byte_encode_file failed: {in_path}")
+    else:
+        with open(in_path, "rb") as f:
+            data = f.read()
+        np.frombuffer(data, dtype=np.uint8).astype(np.uint16).tofile(out_path)
+        n = len(data)
+    with open(out_path + ".meta.json", "w") as f:
+        json.dump({"dtype": "uint16", "count": int(n), "vocab_size": 256}, f)
+    return int(n)
+
+
+__all__ = [
+    "build",
+    "native_available",
+    "NativeTokenBinDataset",
+    "make_fastest_dataset",
+    "byte_encode_file",
+]

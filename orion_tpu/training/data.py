@@ -26,6 +26,34 @@ import numpy as np
 
 Array = jax.Array
 
+_SM64_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_SM64_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_SM64_M2 = np.uint64(0x94D049BB133111EB)
+_STEP_MIX = np.uint64(0xD1B54A32D192ED03)
+_ROW_MIX = np.uint64(0x8CB92BA72F3D8DD7)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer (the canonical sampler hash, mirrored
+    bit-for-bit by runtime/loader.cc)."""
+    with np.errstate(over="ignore"):
+        z = x + _SM64_GAMMA
+        z = (z ^ (z >> np.uint64(30))) * _SM64_M1
+        z = (z ^ (z >> np.uint64(27))) * _SM64_M2
+        return z ^ (z >> np.uint64(31))
+
+
+def window_starts(seed: int, step: int, batch_size: int, n_windows: int) -> np.ndarray:
+    """Deterministic window start offsets for (seed, step)."""
+    rows = np.arange(batch_size, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        x = (
+            np.uint64(seed)
+            ^ (np.uint64(step) * _STEP_MIX)
+            ^ (rows * _ROW_MIX)
+        )
+    return (_splitmix64(x) % np.uint64(n_windows)).astype(np.int64)
+
 
 def write_token_bin(path: str, tokens: np.ndarray, vocab_size: int) -> None:
     """Write the token-bin format (+ sidecar)."""
@@ -59,9 +87,12 @@ class TokenBinDataset:
         assert self.n_windows > 0, f"{path}: too few tokens for seq_len={seq_len}"
 
     def batch(self, seed: int, step: int, batch_size: int) -> np.ndarray:
-        """[B, seq_len+1] int32; pure function of (seed, step)."""
-        rng = np.random.Generator(np.random.Philox(key=seed, counter=step))
-        starts = rng.integers(0, self.n_windows, size=batch_size)
+        """[B, seq_len+1] int32; pure function of (seed, step).
+
+        Window starts come from ``window_starts`` (splitmix64) — the exact
+        same integer stream the C++ loader (runtime/loader.cc) computes, so
+        the fallback and the native path are batch-for-batch identical."""
+        starts = window_starts(seed, step, batch_size, self.n_windows)
         out = np.empty((batch_size, self.seq_len + 1), dtype=np.int32)
         for i, s in enumerate(starts):
             out[i] = self.tokens[s : s + self.seq_len + 1]

@@ -46,6 +46,7 @@ class TrainConfig:
     seq_len: int = 256
     # optimizer
     optimizer: str = "adamw"  # "adamw" | "lion"
+    mu_dtype: Optional[str] = None  # e.g. "bfloat16": halve first-moment HBM
     lr: float = 3e-4
     b1: float = 0.9
     b2: float = 0.95
@@ -118,15 +119,16 @@ def _wd_mask(params: Any) -> Any:
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     sched = make_schedule(cfg)
+    mu_dtype = cfg.mu_dtype
     if cfg.optimizer == "adamw":
         opt = optax.adamw(
             sched, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
-            weight_decay=cfg.weight_decay, mask=_wd_mask,
+            weight_decay=cfg.weight_decay, mask=_wd_mask, mu_dtype=mu_dtype,
         )
     elif cfg.optimizer == "lion":
         opt = optax.lion(
             sched, b1=cfg.b1, b2=cfg.b2,
-            weight_decay=cfg.weight_decay, mask=_wd_mask,
+            weight_decay=cfg.weight_decay, mask=_wd_mask, mu_dtype=mu_dtype,
         )
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")

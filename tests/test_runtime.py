@@ -1,0 +1,83 @@
+"""Native runtime tests (SURVEY.md N1-N3): build the .so, then assert the
+C++ loader produces bit-identical batches to the Python fallback (the
+determinism contract that makes the two paths interchangeable across
+checkpoint resume), and the byte tokenizer paths agree."""
+
+import numpy as np
+import pytest
+
+from orion_tpu import runtime
+from orion_tpu.training.data import TokenBinDataset, window_starts, write_token_bin
+
+
+@pytest.fixture(scope="module")
+def so_built():
+    ok = runtime.native_available() or runtime.build()
+    if not ok or not runtime.native_available():
+        pytest.skip("g++ unavailable; native runtime not built")
+    return True
+
+
+@pytest.fixture()
+def token_file(tmp_path):
+    path = str(tmp_path / "toks.bin")
+    toks = (np.arange(5000, dtype=np.int64) * 7919) % 50000
+    write_token_bin(path, toks, vocab_size=50000)
+    return path
+
+
+def test_native_matches_python_loader(so_built, token_file):
+    seq = 33
+    py = TokenBinDataset(token_file, seq)
+    cc = runtime.NativeTokenBinDataset(token_file, seq)
+    assert cc.n_windows == py.n_windows
+    for seed, step, b in [(0, 0, 4), (1, 0, 8), (0, 123, 3), (42, 7, 16)]:
+        np.testing.assert_array_equal(cc.batch(seed, step, b), py.batch(seed, step, b))
+    cc.close()
+
+
+def test_native_loader_uint16(so_built, tmp_path):
+    path = str(tmp_path / "small.bin")
+    toks = np.arange(300) % 250
+    write_token_bin(path, toks, vocab_size=250)  # uint16 file
+    py = TokenBinDataset(path, 16)
+    cc = runtime.NativeTokenBinDataset(path, 16)
+    np.testing.assert_array_equal(cc.batch(5, 5, 6), py.batch(5, 5, 6))
+    cc.close()
+
+
+def test_window_starts_deterministic():
+    a = window_starts(3, 9, 32, 1000)
+    b = window_starts(3, 9, 32, 1000)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, window_starts(3, 10, 32, 1000))
+    assert (a >= 0).all() and (a < 1000).all()
+
+
+def test_byte_encode_file(so_built, tmp_path):
+    src = tmp_path / "text.txt"
+    src.write_bytes(b"hello orion tpu" * 100)
+    out = str(tmp_path / "text.bin")
+    n = runtime.byte_encode_file(str(src), out)
+    assert n == 1500
+    ds = TokenBinDataset(out, 8)
+    assert ds.vocab_size == 256
+    b = ds.batch(0, 0, 2)
+    assert (b < 256).all()
+
+
+def test_byte_encode_file_python_fallback(tmp_path, monkeypatch):
+    monkeypatch.setattr(runtime, "_load", lambda: None)
+    src = tmp_path / "t.txt"
+    src.write_bytes(b"abcdef" * 50)
+    out = str(tmp_path / "t.bin")
+    n = runtime.byte_encode_file(str(src), out)
+    assert n == 300
+    arr = np.fromfile(out, dtype=np.uint16)
+    assert arr[0] == ord("a")
+
+
+def test_make_fastest_dataset(token_file):
+    ds = runtime.make_fastest_dataset(token_file, 16)
+    b = ds.batch(0, 0, 2)
+    assert b.shape == (2, 17)
