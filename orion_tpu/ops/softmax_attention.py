@@ -69,8 +69,10 @@ def softmax_attention_xla(
 
     m = _build_mask(q.shape[-2], k.shape[-2], causal, window)
     if mask is not None:
-        if mask.ndim < 2 or mask.shape[-2] != q.shape[-2]:
-            mask = mask[..., None, :]  # key-padding [..., Tk] -> over queries
+        # accept key-padding [..., Tk] (expand over queries) or anything
+        # already broadcastable against [..., Tq, Tk] (dim -2 == Tq or 1)
+        if mask.ndim < 2 or mask.shape[-2] not in (1, q.shape[-2]):
+            mask = mask[..., None, :]
         m = mask if m is None else (m & mask)
     if m is not None:
         scores = jnp.where(m, scores, _NEG)

@@ -1,0 +1,302 @@
+"""`python -m orion_tpu.train_lra` — LRA classification training
+(SURVEY.md T7 / M5).
+
+The reference's LRA eval configs compare linear vs softmax attention on
+ListOps and Text (BASELINE.json; reference checkout never mounted —
+SURVEY.md §0). This script trains ``LRAClassifier`` on either:
+
+- real LRA TSV data (``--data dir`` with train.tsv/val.tsv: "<label>\\t<seq>"
+  where seq is space-separated token ids for ListOps or raw text for Text), or
+- the built-in synthetic stand-ins (offline-friendly, same API): "listops"
+  (nested bracket max/min-style reductions over digits, exercises
+  hierarchical long-range structure) and "text" (byte sequences whose label
+  is decided by a long-range pattern).
+
+Library: ``train_lra(LRATrainConfig(...)) -> (params, metrics)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+from functools import partial
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from orion_tpu.models.classifier import LRAClassifier
+from orion_tpu.models.configs import ModelConfig, get_config
+from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+from orion_tpu.parallel.sharding import batch_sharding, param_shardings
+from orion_tpu.training.metrics import MetricsLogger
+from orion_tpu.training.trainer import make_schedule
+from orion_tpu.utils import rng as rngs
+
+
+# ---------------------------------------------------------------------------
+# Synthetic LRA stand-ins (deterministic, offline)
+# ---------------------------------------------------------------------------
+
+
+class SyntheticListOps:
+    """Sequences of digit tokens (0-9) with bracket markers; label = result
+    of a running max/min/median-style reduction — long-range because early
+    operators scope the whole suffix. Tokens: 0-9 digits, 10 '[MAX', 11
+    '[MIN', 12 ']'. n_classes=10."""
+
+    vocab_size = 16
+    n_classes = 10
+
+    def __init__(self, seq_len: int):
+        self.seq_len = seq_len
+
+    def batch(self, seed: int, step: int, b: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        rng = np.random.Generator(np.random.Philox(key=seed, counter=step))
+        t = self.seq_len
+        toks = rng.integers(0, 10, size=(b, t))
+        ops = rng.integers(10, 12, size=(b,))
+        toks[:, 0] = ops  # operator at position 0 scopes the whole sequence
+        digits = toks[:, 1:]
+        labels = np.where(
+            ops == 10, digits.max(axis=1), digits.min(axis=1)
+        ).astype(np.int32)
+        mask = np.ones((b, t), dtype=bool)
+        return toks.astype(np.int32), labels, mask
+
+
+class SyntheticText:
+    """Byte-like sequences; label = whether token 7 appears more often in
+    the first half than the second (forces global aggregation)."""
+
+    vocab_size = 256
+    n_classes = 2
+
+    def __init__(self, seq_len: int):
+        self.seq_len = seq_len
+
+    def batch(self, seed: int, step: int, b: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        rng = np.random.Generator(np.random.Philox(key=seed, counter=step))
+        t = self.seq_len
+        toks = rng.integers(0, 32, size=(b, t)).astype(np.int32)
+        half = t // 2
+        c1 = (toks[:, :half] == 7).sum(axis=1)
+        c2 = (toks[:, half:] == 7).sum(axis=1)
+        labels = (c1 > c2).astype(np.int32)
+        mask = np.ones((b, t), dtype=bool)
+        return toks, labels, mask
+
+
+class TSVDataset:
+    """Real LRA data: '<label>\\t<sequence>' rows. ListOps = space-separated
+    ids; Text = raw bytes."""
+
+    def __init__(self, path: str, seq_len: int, mode: str, n_classes: int,
+                 vocab_size: int):
+        self.seq_len = seq_len
+        self.n_classes = n_classes
+        self.vocab_size = vocab_size
+        self.samples = []
+        with open(path) as f:
+            for line in f:
+                label, _, seq = line.rstrip("\n").partition("\t")
+                if mode == "ids":
+                    ids = [int(x) for x in seq.split()][:seq_len]
+                else:
+                    ids = list(seq.encode("utf-8"))[:seq_len]
+                self.samples.append((int(label), ids))
+
+    def batch(self, seed: int, step: int, b: int):
+        rng = np.random.Generator(np.random.Philox(key=seed, counter=step))
+        idx = rng.integers(0, len(self.samples), size=b)
+        toks = np.zeros((b, self.seq_len), dtype=np.int32)
+        mask = np.zeros((b, self.seq_len), dtype=bool)
+        labels = np.zeros((b,), dtype=np.int32)
+        for i, j in enumerate(idx):
+            label, ids = self.samples[j]
+            labels[i] = label
+            toks[i, : len(ids)] = ids
+            mask[i, : len(ids)] = True
+        return toks, labels, mask
+
+
+# ---------------------------------------------------------------------------
+# Training
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LRATrainConfig:
+    model: ModelConfig = dataclasses.field(
+        default_factory=lambda: get_config("lra_listops_linear")
+    )
+    task: str = "listops"  # "listops" | "text" | path to data dir
+    steps: int = 2000
+    batch_size: int = 32
+    seq_len: int = 512
+    lr: float = 1e-3
+    warmup_steps: int = 100
+    weight_decay: float = 0.01
+    clip_norm: float = 1.0
+    schedule: str = "cosine"
+    min_lr_ratio: float = 0.1
+    optimizer: str = "adamw"
+    b1: float = 0.9
+    b2: float = 0.98
+    eps: float = 1e-8
+    mu_dtype: Optional[str] = None
+    accum_steps: int = 1
+    mesh: MeshConfig = MeshConfig()
+    seed: int = 0
+    log_every: int = 50
+    eval_every: int = 500
+    eval_batches: int = 10
+    nan_policy: str = "skip"
+
+
+def make_lra_dataset(cfg: LRATrainConfig, split: str = "train"):
+    if cfg.task == "listops":
+        return SyntheticListOps(cfg.seq_len)
+    if cfg.task == "text":
+        return SyntheticText(cfg.seq_len)
+    mode = "ids" if cfg.model.vocab_size < 256 else "bytes"
+    path = os.path.join(cfg.task, f"{split}.tsv")
+    return TSVDataset(
+        path, cfg.seq_len, mode, cfg.model.n_classes, cfg.model.vocab_size
+    )
+
+
+def train_lra(cfg: LRATrainConfig, logger: Optional[MetricsLogger] = None):
+    mesh = make_mesh(cfg.mesh)
+    model = LRAClassifier(cfg.model)
+    # reuse the LM trainer's optimizer/schedule plumbing
+    from orion_tpu.training import trainer as tr
+
+    shim = tr.TrainConfig(
+        model=cfg.model, steps=cfg.steps, lr=cfg.lr,
+        warmup_steps=cfg.warmup_steps, weight_decay=cfg.weight_decay,
+        clip_norm=cfg.clip_norm, schedule=cfg.schedule,
+        min_lr_ratio=cfg.min_lr_ratio, optimizer=cfg.optimizer,
+        b1=cfg.b1, b2=cfg.b2, eps=cfg.eps, mu_dtype=cfg.mu_dtype,
+    )
+    tx = tr.make_optimizer(shim)
+    sched = make_schedule(shim)
+
+    root = rngs.root_key(cfg.seed)
+    ds = make_lra_dataset(cfg)
+    assert ds.vocab_size <= cfg.model.vocab_size, (ds.vocab_size, cfg.model)
+    assert ds.n_classes == cfg.model.n_classes, (ds.n_classes, cfg.model)
+
+    sample_toks = jnp.zeros((1, cfg.seq_len), jnp.int32)
+    sample_mask = jnp.ones((1, cfg.seq_len), bool)
+
+    def init_fn(rng):
+        params = model.init(rng, sample_toks, sample_mask)
+        return {"params": params, "opt": tx.init(params), "step": jnp.zeros((), jnp.int32)}
+
+    abstract = jax.eval_shape(init_fn, rngs.stream(root, "init"))
+    shardings = param_shardings(abstract, mesh)
+    state = jax.jit(init_fn, out_shardings=shardings)(rngs.stream(root, "init"))
+    bshard = batch_sharding(mesh)
+
+    def loss_fn(params, toks, labels, mask, rng):
+        use_drop = cfg.model.dropout > 0.0
+        kwargs = (
+            {"rngs": {"dropout": rng}, "deterministic": False} if use_drop else {}
+        )
+        logits = model.apply(params, toks, mask, **kwargs)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        return loss.mean(), acc.mean()
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state, toks, labels, mask):
+        rng = rngs.at_step(rngs.stream(root, "dropout"), state["step"])
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], toks, labels, mask, rng
+        )
+        gnorm = optax.global_norm(grads)
+        finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+        safe = jax.tree.map(lambda g: jnp.where(finite, g, 0.0), grads)
+        updates, opt = tx.update(safe, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        sel = lambda n, o: jax.tree.map(  # noqa: E731
+            lambda a, b: jnp.where(finite, a, b), n, o
+        )
+        new_state = {
+            "params": sel(params, state["params"]),
+            "opt": sel(opt, state["opt"]),
+            "step": state["step"] + 1,
+        }
+        return new_state, {
+            "loss": loss, "acc": acc, "grad_norm": gnorm,
+            "lr": sched(state["step"]), "nonfinite": (~finite).astype(jnp.int32),
+        }
+
+    @jax.jit
+    def eval_fn(params, toks, labels, mask):
+        logits = model.apply(params, toks, mask)
+        return (jnp.argmax(logits, -1) == labels).astype(jnp.float32).mean()
+
+    def put(x):
+        return jax.device_put(x, bshard) if x.ndim >= 1 else x
+
+    last = {}
+    for step in range(1, cfg.steps + 1):
+        toks, labels, mask = ds.batch(cfg.seed, step - 1, cfg.batch_size)
+        state, metrics = step_fn(
+            state, put(jnp.asarray(toks)), jnp.asarray(labels), put(jnp.asarray(mask))
+        )
+        if step % cfg.log_every == 0 or step == cfg.steps:
+            last = {k: float(v) for k, v in metrics.items()}
+            if logger:
+                logger.log(step, last, cfg.batch_size * cfg.seq_len)
+        if cfg.eval_every and (step % cfg.eval_every == 0 or step == cfg.steps):
+            eval_ds = make_lra_dataset(cfg, "val") if os.path.isdir(cfg.task) else ds
+            accs = []
+            for i in range(cfg.eval_batches):
+                toks, labels, mask = eval_ds.batch(
+                    cfg.seed + 99, 10_000_000 + i, cfg.batch_size
+                )
+                accs.append(float(eval_fn(
+                    state["params"], put(jnp.asarray(toks)), jnp.asarray(labels),
+                    put(jnp.asarray(mask)),
+                )))
+            last["eval_acc"] = sum(accs) / len(accs)
+            if logger:
+                logger.log(step, {"eval_acc": last["eval_acc"]})
+    return state["params"], last
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("orion_tpu.train_lra")
+    p.add_argument("--config", default="lra_listops_linear")
+    p.add_argument("--task", default="listops")
+    p.add_argument("--steps", type=int, default=2000)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-path", default=None)
+    args = p.parse_args(argv)
+
+    model = get_config(args.config, max_seq_len=args.seq_len + 8)
+    cfg = LRATrainConfig(
+        model=model, task=args.task, steps=args.steps,
+        batch_size=args.batch_size, seq_len=args.seq_len, lr=args.lr,
+        seed=args.seed,
+    )
+    logger = MetricsLogger(args.log_path)
+    t0 = time.time()
+    _, last = train_lra(cfg, logger)
+    print({k: round(v, 4) for k, v in last.items()}, f"({time.time()-t0:.0f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

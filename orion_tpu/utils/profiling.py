@@ -1,0 +1,66 @@
+"""Profiling/tracing hooks (SURVEY.md A1).
+
+The reference exposes torch-profiler hooks around its training loop
+(BASELINE.json; reference checkout never mounted — SURVEY.md §0). The TPU
+equivalents: ``trace(logdir)`` wraps a region in a ``jax.profiler`` trace
+viewable in TensorBoard/Perfetto (device timelines, HLO cost, HBM usage);
+``StepTimer`` gives cheap host-side per-step wall times + tokens/sec
+percentiles without any device sync beyond what the caller already does.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str, with_memory: bool = True):
+    """Profile a region: `with trace("/tmp/tb"): trainer.step(batch)`."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named sub-region inside a trace (shows up on the TraceMe timeline)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Host-side step timing; call mark() once per step (after any sync the
+    loop already performs)."""
+
+    def __init__(self, tokens_per_step: int = 0):
+        self.tokens_per_step = tokens_per_step
+        self._times: List[float] = []
+        self._last: Optional[float] = None
+
+    def mark(self):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+        self._last = now
+
+    def summary(self) -> Dict[str, float]:
+        if not self._times:
+            return {}
+        ts = sorted(self._times)
+        n = len(ts)
+        out = {
+            "steps": float(n),
+            "p50_ms": 1000 * ts[n // 2],
+            "p90_ms": 1000 * ts[min(n - 1, int(n * 0.9))],
+            "mean_ms": 1000 * sum(ts) / n,
+        }
+        if self.tokens_per_step:
+            out["tokens_per_sec"] = self.tokens_per_step / (sum(ts) / n)
+        return out
+
+
+__all__ = ["trace", "annotate", "StepTimer"]
