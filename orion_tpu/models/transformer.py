@@ -51,11 +51,15 @@ def _norm(cfg: ModelConfig, name: str):
 
 
 class Attention(nn.Module):
-    """One attention layer of type 'linear' | 'softmax' | 'swa'."""
+    """One attention layer of type 'linear' | 'softmax' | 'swa'.
+
+    ``mesh`` + cfg.sequence_parallel switches the causal parallel forward to
+    token-sharded execution over the mesh's sp axis (SURVEY.md P5/P6)."""
 
     cfg: ModelConfig
     layer_type: str
     causal: bool = True
+    mesh: Optional[Any] = None
 
     def setup(self):
         cfg = self.cfg
@@ -120,13 +124,30 @@ class Attention(nn.Module):
 
     # -- parallel forward ---------------------------------------------------
 
+    def _sp_active(self) -> bool:
+        return (
+            self.cfg.sequence_parallel
+            and self.causal
+            and self.mesh is not None
+            and self.mesh.shape.get("sp", 1) > 1
+        )
+
     def __call__(self, x: Array, mask: Optional[Array] = None) -> Array:
         cfg = self.cfg
         q, k, v = self._heads(x)
         t = x.shape[-2]
+        sp = self._sp_active()
+        if sp:
+            assert t % self.mesh.shape["sp"] == 0, (t, dict(self.mesh.shape))
         if self.layer_type == "linear":
             qf, kf = self._phi_map(q), self._phi_map(k)
-            if self.causal:
+            if sp:
+                from orion_tpu.parallel.sequence import sp_linear_attention
+
+                out = sp_linear_attention(
+                    qf, kf, v, self.mesh, backend=cfg.backend, chunk=cfg.chunk
+                )
+            elif self.causal:
                 out = linear_attention(
                     qf, kf, v, backend=cfg.backend, chunk=cfg.chunk
                 )
@@ -138,11 +159,18 @@ class Attention(nn.Module):
             q = apply_rotary(q, ang)
             k = apply_rotary(k, ang)
             window = cfg.window if self.layer_type == "swa" else None
-            am = None if mask is None else mask[:, None, None, :]
-            out = softmax_attention(
-                q, k, v, causal=self.causal, window=window,
-                mask=am, backend=cfg.backend,
-            )
+            if sp:
+                from orion_tpu.parallel.ring import ring_attention
+
+                out = ring_attention(
+                    q, k, v, self.mesh, causal=True, window=window
+                )
+            else:
+                am = None if mask is None else mask[:, None, None, :]
+                out = softmax_attention(
+                    q, k, v, causal=self.causal, window=window,
+                    mask=am, backend=cfg.backend,
+                )
         return self._merge(out, single=False)
 
     # -- prefill: forward + decode state ------------------------------------
@@ -255,10 +283,13 @@ class Block(nn.Module):
     cfg: ModelConfig
     layer_type: str
     causal: bool = True
+    mesh: Optional[Any] = None
 
     def setup(self):
         self.norm1 = _norm(self.cfg, "norm1")
-        self.attn = Attention(self.cfg, self.layer_type, self.causal, name="attn")
+        self.attn = Attention(
+            self.cfg, self.layer_type, self.causal, self.mesh, name="attn"
+        )
         self.norm2 = _norm(self.cfg, "norm2")
         self.mlp = MLP(self.cfg, name="mlp")
         self.drop = nn.Dropout(self.cfg.dropout)
@@ -285,6 +316,7 @@ class TransformerLM(nn.Module):
     """Decoder LM over token ids; see module docstring for the 3 methods."""
 
     cfg: ModelConfig
+    mesh: Optional[Any] = None
 
     def setup(self):
         cfg = self.cfg
@@ -295,7 +327,7 @@ class TransformerLM(nn.Module):
         if cfg.remat:
             block_cls = nn.remat(Block, static_argnums=(3,))
         self.blocks = [
-            block_cls(cfg, lt, True, name=f"block_{i}")
+            block_cls(cfg, lt, True, self.mesh, name=f"block_{i}")
             for i, lt in enumerate(cfg.resolved_layer_types)
         ]
         self.final_norm = _norm(cfg, "final_norm")

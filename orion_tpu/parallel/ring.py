@@ -54,19 +54,21 @@ def ring_attention_local(
     axis: str = "sp",
     *,
     causal: bool = True,
+    window: Optional[int] = None,
     scale: Optional[float] = None,
 ) -> Array:
     """shard_map body: q,k,v LOCAL [..., T/sp, D] shards; exact softmax
-    attention over the full (global) sequence."""
+    attention over the full (global) sequence. ``window`` gives the
+    sliding-window variant (query t sees keys (t-window, t]) so the 7B
+    hybrid's swa layers can ride the same ring."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = lax.axis_size(axis)
     i = lax.axis_index(axis)
     t_loc = q.shape[-2]
 
-    row = jnp.arange(t_loc)[:, None]
-    col = jnp.arange(t_loc)[None, :]
-    diag_mask = row >= col  # intra-block causal
+    local_row = jnp.arange(t_loc)[:, None]
+    local_col = jnp.arange(t_loc)[None, :]
 
     # derive initializers from q so they carry the same device-varying type
     # as the loop-body outputs (shard_map vma rules for lax.cond branches)
@@ -78,27 +80,28 @@ def ring_attention_local(
     def body(step, carry):
         k_blk, v_blk, m, l, acc = carry
         j = (i - step) % n  # origin shard of the block currently held
+        rows = i * t_loc + local_row  # absolute positions (traced via i, j)
+        cols = j * t_loc + local_col
+        mask = jnp.ones((t_loc, t_loc), bool)
+        if causal:
+            mask &= rows >= cols
+        if window is not None:
+            mask &= (rows - cols) < window
+        needs_mask = causal or window is not None
 
-        def attend_full(args):
+        def attend(args):
             m, l, acc = args
-            return _block_attend(q, k_blk, v_blk, m, l, acc, scale, None)
-
-        def attend_diag(args):
-            m, l, acc = args
-            return _block_attend(q, k_blk, v_blk, m, l, acc, scale, diag_mask)
+            return _block_attend(
+                q, k_blk, v_blk, m, l, acc, scale, mask if needs_mask else None
+            )
 
         def skip(args):
             return args
 
-        if causal:
-            m, l, acc = lax.cond(
-                j < i,
-                attend_full,
-                lambda args: lax.cond(j == i, attend_diag, skip, args),
-                (m, l, acc),
-            )
+        if needs_mask:
+            m, l, acc = lax.cond(jnp.any(mask), attend, skip, (m, l, acc))
         else:
-            m, l, acc = attend_full((m, l, acc))
+            m, l, acc = attend((m, l, acc))
 
         # rotate kv to the next device; after n-1 steps every block visited
         perm = [(d, (d + 1) % n) for d in range(n)]
@@ -119,12 +122,16 @@ def ring_attention(
     *,
     axis: str = "sp",
     causal: bool = True,
+    window: Optional[int] = None,
     scale: Optional[float] = None,
 ) -> Array:
     """Global entry: q,k,v [B, H, T, D] with T sharded over ``axis``."""
     spec = P(("dp", "fsdp"), "tp", axis, None)
     fn = shard_map(
-        partial(ring_attention_local, axis=axis, causal=causal, scale=scale),
+        partial(
+            ring_attention_local, axis=axis, causal=causal, window=window,
+            scale=scale,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

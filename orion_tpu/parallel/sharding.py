@@ -82,10 +82,10 @@ def shard_params(params: Any, mesh: Mesh) -> Any:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Batch dim sharded over (dp, fsdp); sequence dim over sp (sequence
-    parallelism slices the tokens too); everything else replicated."""
-    if mesh.shape["sp"] > 1:
-        return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    """Batch dim sharded over (dp, fsdp). The sequence dim stays replicated
+    over sp even in sequence-parallel runs: the raw [B, T+1] LM batch isn't
+    sp-divisible (the +1 shift), and GSPMD re-shards the activations at the
+    attention shard_map boundary where the sp layout actually matters."""
     return NamedSharding(mesh, P(("dp", "fsdp")))
 
 

@@ -1,0 +1,65 @@
+"""`python -m orion_tpu.prepare_data` — corpus → token-bin converter
+(SURVEY.md T5: C4/WikiText adapters feed this format).
+
+Byte-level tokenization of text/raw files into the framework's token-bin
+format (flat uint16 + JSON sidecar), using the C++ streaming encoder when
+built (runtime/tokenizer.cc), Python otherwise. HuggingFace-style JSONL
+corpora (one {"text": ...} per line — the C4 layout) are supported with
+--jsonl; plain text/WikiText files concatenate as-is.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def prepare(
+    inputs: list[str],
+    out_path: str,
+    jsonl: bool = False,
+    field: str = "text",
+    sep: bytes = b"\n\n",
+) -> int:
+    from orion_tpu import runtime
+
+    if not jsonl and len(inputs) == 1:
+        return runtime.byte_encode_file(inputs[0], out_path)
+
+    total = 0
+    with open(out_path, "wb") as out:
+        for path in inputs:
+            with open(path, "rb") as f:
+                if jsonl:
+                    for line in f:
+                        if not line.strip():
+                            continue
+                        text = json.loads(line)[field].encode("utf-8") + sep
+                        np.frombuffer(text, dtype=np.uint8).astype(np.uint16).tofile(out)
+                        total += len(text)
+                else:
+                    data = f.read() + sep
+                    np.frombuffer(data, dtype=np.uint8).astype(np.uint16).tofile(out)
+                    total += len(data)
+    with open(out_path + ".meta.json", "w") as f:
+        json.dump({"dtype": "uint16", "count": total, "vocab_size": 256}, f)
+    return total
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("orion_tpu.prepare_data")
+    p.add_argument("inputs", nargs="+", help="text or JSONL files")
+    p.add_argument("--out", required=True, help="output token-bin path")
+    p.add_argument("--jsonl", action="store_true", help="inputs are JSONL (C4-style)")
+    p.add_argument("--field", default="text", help="JSONL text field")
+    args = p.parse_args(argv)
+    n = prepare(args.inputs, args.out, args.jsonl, args.field)
+    print(f"wrote {n} tokens to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

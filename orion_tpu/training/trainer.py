@@ -153,7 +153,9 @@ class Trainer:
     def __init__(self, cfg: TrainConfig, mesh: Optional[Mesh] = None):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
-        self.model = TransformerLM(cfg.model)
+        self.model = TransformerLM(
+            cfg.model, mesh=self.mesh if cfg.model.sequence_parallel else None
+        )
         self.tx = make_optimizer(cfg)
         self.sched = make_schedule(cfg)
         self.batch_shd = batch_sharding(self.mesh)
