@@ -211,11 +211,21 @@ def linear_attention(
     """Normalized causal linear attention over feature-mapped q, k.
 
     out[t] = (q_t · S_t) / (q_t · z_t + eps),  S_t = Σ_{s<=t} k_s⊗v_s,
-    z_t = Σ_{s<=t} k_s. The numerator goes through ``causal_dot_product``
-    (dispatched to Pallas or XLA by ``backend``); the normalizer is a
-    cumulative sum XLA handles well on its own.
+    z_t = Σ_{s<=t} k_s. On the Pallas backend the whole op — numerator,
+    normalizer, and both carried states — is one fused kernel pass
+    (``linear_attention_pallas_fused``). On XLA, the numerator goes through
+    ``causal_dot_product`` and the normalizer is a cumulative sum.
     """
-    from orion_tpu.ops.dispatch import causal_dot_product  # cycle-free import
+    from orion_tpu.ops.dispatch import causal_dot_product, resolve  # cycle-free
+
+    b = resolve(backend)
+    if b in ("pallas", "pallas_interpret"):
+        from orion_tpu.ops.pallas.causal_dot import linear_attention_pallas_fused
+
+        return linear_attention_pallas_fused(
+            q, k, v, chunk=chunk, eps=eps, initial_state=initial_state,
+            return_state=return_state, interpret=(b == "pallas_interpret"),
+        )
 
     s0 = z0 = None
     if initial_state is not None:

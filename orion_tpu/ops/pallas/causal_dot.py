@@ -199,4 +199,222 @@ def causal_dot_product_pallas(
     return out
 
 
-__all__ = ["causal_dot_product_pallas"]
+# ---------------------------------------------------------------------------
+# Fused normalized linear attention: numerator, denominator, and both carried
+# states (S, z) in ONE kernel pass — no separate fp32 cumsum over HBM for the
+# normalizer (the reference fuses the same way inside its CUDA kernel pair:
+# causal_dot_product + kv-cumsum; BASELINE.json north_star).
+# ---------------------------------------------------------------------------
+
+
+def _kernel_norm(
+    q_ref, k_ref, v_ref, s0_ref, z0_ref,
+    num_ref, den_ref, sf_ref, zf_ref,
+    s_scr, z_scr,
+):
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _():
+        s_scr[:] = s0_ref[0].astype(jnp.float32)
+        z_scr[:] = z0_ref[0].astype(jnp.float32)
+
+    qi = q_ref[0]  # (C, Dk)
+    ki = k_ref[0]
+    vi = v_ref[0]
+
+    scores = jax.lax.dot_general(
+        qi, ki,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    cdim = scores.shape[0]
+    row = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 1)
+    scores = jnp.where(row >= col, scores, 0.0)
+
+    intra = jnp.dot(scores, vi.astype(jnp.float32), preferred_element_type=jnp.float32)
+    inter = jnp.dot(qi.astype(jnp.float32), s_scr[:], preferred_element_type=jnp.float32)
+    num_ref[0] = intra + inter
+
+    den_intra = jnp.sum(scores, axis=1, keepdims=True)  # (C, 1)
+    den_inter = jax.lax.dot_general(
+        qi, z_scr[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (C, 1)
+    den_ref[0] = den_intra + den_inter
+
+    s_scr[:] = s_scr[:] + jax.lax.dot_general(
+        ki, vi,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    z_scr[:] = z_scr[:] + jnp.sum(
+        ki.astype(jnp.float32), axis=0, keepdims=True
+    )
+    sf_ref[0] = s_scr[:]
+    zf_ref[0] = z_scr[:]
+
+
+def _cdpn_flat(q, k, v, s0, z0, chunk, interpret):
+    """Fused pass on flat [BH, T, D] inputs (T % chunk == 0): returns
+    (num fp32, den fp32 [BH,T,1], sf fp32, zf fp32 [BH,1,Dk])."""
+    bh, t, dk = q.shape
+    dv = v.shape[-1]
+    nc = t // chunk
+
+    num, den, sf, zf = pl.pallas_call(
+        _kernel_norm,
+        grid=(bh, nc),
+        in_specs=[
+            pl.BlockSpec((1, chunk, dk), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dk), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dv), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dk, dv), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, chunk, dv), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, 1), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dk, dv), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, dv), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, dk, dv), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, dk), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((dk, dv), jnp.float32),
+            pltpu.VMEM((1, dk), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, s0, z0)
+    return num, den, sf, zf
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _lin_attn_fused(q, k, v, s0, z0, chunk, eps, interpret):
+    num, den, sf, zf = _cdpn_flat(q, k, v, s0, z0, chunk, interpret)
+    out = (num / (den + eps)).astype(q.dtype)
+    return out, sf, zf
+
+
+def _lin_attn_fused_fwd(q, k, v, s0, z0, chunk, eps, interpret):
+    num, den, sf, zf = _cdpn_flat(q, k, v, s0, z0, chunk, interpret)
+    out = (num / (den + eps)).astype(q.dtype)
+    return (out, sf, zf), (q, k, v, s0, z0, num, den)
+
+
+def _lin_attn_fused_bwd(chunk, eps, interpret, res, cts):
+    q, k, v, s0, z0, num, den = res
+    gout, gsf, gzf = cts
+    gout = gout.astype(jnp.float32)
+    d = den + eps  # (BH, T, 1) fp32
+    gnum = (gout / d).astype(q.dtype)
+    gden = -jnp.sum(gout * num, axis=-1, keepdims=True) / (d * d)  # (BH, T, 1)
+    gsf32 = gsf.astype(jnp.float32)
+
+    # numerator part: the time-flip kernel identities (see module docstring)
+    rev = lambda x: jnp.flip(x, axis=-2)  # noqa: E731
+    zkk = jnp.zeros((q.shape[0], v.shape[-1], q.shape[-1]), jnp.float32)
+    zvv = jnp.zeros((q.shape[0], v.shape[-1], q.shape[-1]), jnp.float32)
+    zqq = jnp.zeros((q.shape[0], q.shape[-1], v.shape[-1]), jnp.float32)
+    dq, _ = _cdp_flat(gnum, v, k, zkk, chunk, interpret)
+    dq = dq.astype(jnp.float32) + jnp.einsum(
+        "bte,bde->btd", gnum.astype(jnp.float32), s0.astype(jnp.float32)
+    )
+    dk, _ = _cdp_flat(rev(v), rev(gnum), rev(q), zvv, chunk, interpret)
+    dk = rev(dk).astype(jnp.float32) + jnp.einsum(
+        "bte,bde->btd", v.astype(jnp.float32), gsf32
+    )
+    dv, _ = _cdp_flat(rev(k), rev(q), rev(gnum), zqq, chunk, interpret)
+    dv = rev(dv).astype(jnp.float32) + jnp.einsum(
+        "btd,bde->bte", k.astype(jnp.float32), gsf32
+    )
+    ds0 = (
+        jnp.einsum(
+            "btd,bte->bde", q.astype(jnp.float32), gnum.astype(jnp.float32)
+        )
+        + gsf32
+    )
+
+    # denominator part: den[t] = q_t·z0 + Σ_{s<=t} q_t·k_s  (cheap XLA cumsums)
+    kf = k.astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    zcum = jnp.cumsum(kf, axis=-2) + z0.astype(jnp.float32)  # (BH,1,Dk) bcast
+    gq_den = gden * zcum
+    gk_den = rev(jnp.cumsum(rev(gden * qf), axis=-2))
+    gz0 = jnp.sum(gden * qf, axis=-2, keepdims=True)  # (BH, 1, Dk)
+
+    # final-z cotangent: zf = z0 + Σ_s k_s
+    gzf32 = gzf.astype(jnp.float32)
+    dq_total = dq + gq_den
+    dk_total = dk + gk_den + gzf32
+    dz0 = gz0 + gzf32
+
+    return (
+        dq_total.astype(q.dtype),
+        dk_total.astype(k.dtype),
+        dv.astype(v.dtype),
+        ds0,
+        dz0,
+    )
+
+
+_lin_attn_fused.defvjp(_lin_attn_fused_fwd, _lin_attn_fused_bwd)
+
+
+def linear_attention_pallas_fused(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    chunk: int = 128,
+    eps: float = 1e-6,
+    initial_state: Optional[Tuple[Array, Array]] = None,
+    return_state: bool = False,
+    interpret: bool = False,
+):
+    """Normalized causal linear attention, fully fused in one Pallas pass.
+
+    out[t] = q_t·S_t / (q_t·z_t + eps) with S, z the kv-cumsum states;
+    optionally seeded by ``initial_state=(S0 [..,Dk,Dv], z0 [..,Dk])`` and
+    returning the final (S, z) — the prefill→decode handoff. Differentiable
+    through everything including the states (custom VJP: kernel passes for
+    the numerator, O(T·Dk) cumsums for the denominator)."""
+    batch_shape = q.shape[:-2]
+    t, dk = q.shape[-2], q.shape[-1]
+    dv = v.shape[-1]
+    bh = 1
+    for s in batch_shape:
+        bh *= s
+
+    qf = q.reshape(bh, t, dk)
+    kf = k.reshape(bh, t, dk)
+    vf = v.reshape(bh, t, dv)
+    rem = (-t) % chunk
+    if rem:
+        pad = ((0, 0), (0, rem), (0, 0))
+        qf, kf, vf = jnp.pad(qf, pad), jnp.pad(kf, pad), jnp.pad(vf, pad)
+
+    if initial_state is None:
+        s0 = jnp.zeros((bh, dk, dv), jnp.float32)
+        z0 = jnp.zeros((bh, 1, dk), jnp.float32)
+    else:
+        s0 = initial_state[0].astype(jnp.float32).reshape(bh, dk, dv)
+        z0 = initial_state[1].astype(jnp.float32).reshape(bh, 1, dk)
+
+    out, sf, zf = _lin_attn_fused(qf, kf, vf, s0, z0, chunk, eps, interpret)
+    out = out[:, :t, :].reshape(*batch_shape, t, dv)
+    if return_state:
+        return out, (
+            sf.reshape(*batch_shape, dk, dv),
+            zf.reshape(*batch_shape, dk),
+        )
+    return out
+
+
+__all__ = ["causal_dot_product_pallas", "linear_attention_pallas_fused"]

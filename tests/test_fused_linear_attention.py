@@ -1,0 +1,122 @@
+"""Parity tests for the fused normalized linear-attention Pallas kernel
+(interpret mode on CPU) against the XLA path: values, grads (incl. through
+initial/final states), bf16, and the dispatch route."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.ops.linear_attention import kv_state, linear_attention
+from orion_tpu.ops.pallas.causal_dot import linear_attention_pallas_fused
+
+
+def _inputs(key, b, h, t, d, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    phi = lambda x: jax.nn.elu(x) + 1.0  # noqa: E731
+    q = phi(jax.random.normal(k1, (b, h, t, d))).astype(dtype)
+    k = phi(jax.random.normal(k2, (b, h, t, d))).astype(dtype)
+    v = jax.random.normal(k3, (b, h, t, d)).astype(dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("t", [32, 50])
+def test_fused_matches_xla(t):
+    q, k, v = _inputs(jax.random.PRNGKey(0), 2, 2, t, 8)
+    ref = linear_attention(q, k, v, backend="xla", chunk=16)
+    got = linear_attention_pallas_fused(q, k, v, chunk=16, interpret=True)
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_fused_with_state_roundtrip():
+    q, k, v = _inputs(jax.random.PRNGKey(1), 1, 2, 48, 8)
+    ref, (s_ref, z_ref) = linear_attention(
+        q, k, v, backend="xla", chunk=16, return_state=True
+    )
+    got, (s, z) = linear_attention_pallas_fused(
+        q, k, v, chunk=16, return_state=True, interpret=True
+    )
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(s, s_ref, atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(z, z_ref, atol=1e-4, rtol=1e-4)
+
+
+def test_fused_initial_state_continuation():
+    """Running [first half] then [second half seeded with the state] must
+    equal one full pass (the SP/prefill invariant)."""
+    q, k, v = _inputs(jax.random.PRNGKey(2), 1, 1, 32, 8)
+    full = linear_attention_pallas_fused(q, k, v, chunk=8, interpret=True)
+    h = 16
+    out1, st = linear_attention_pallas_fused(
+        q[..., :h, :], k[..., :h, :], v[..., :h, :],
+        chunk=8, return_state=True, interpret=True,
+    )
+    out2 = linear_attention_pallas_fused(
+        q[..., h:, :], k[..., h:, :], v[..., h:, :],
+        chunk=8, initial_state=st, interpret=True,
+    )
+    np.testing.assert_allclose(
+        jnp.concatenate([out1, out2], axis=-2), full, atol=1e-5, rtol=1e-5
+    )
+
+
+def test_fused_grads_match_xla():
+    q, k, v = _inputs(jax.random.PRNGKey(3), 1, 2, 24, 8)
+    w = jax.random.normal(jax.random.PRNGKey(4), v.shape)
+
+    def loss_x(q, k, v):
+        return jnp.sum(linear_attention(q, k, v, backend="xla", chunk=8) * w)
+
+    def loss_f(q, k, v):
+        return jnp.sum(
+            linear_attention_pallas_fused(q, k, v, chunk=8, interpret=True) * w
+        )
+
+    gx = jax.grad(loss_x, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+
+def test_fused_grads_through_states():
+    """Grads must flow through initial_state and the returned state —
+    what makes SP training differentiable."""
+    q, k, v = _inputs(jax.random.PRNGKey(5), 1, 1, 16, 4)
+    s0, z0 = kv_state(k, v)  # arbitrary nonzero state
+    wS = jax.random.normal(jax.random.PRNGKey(6), s0.shape)
+
+    def loss_f(q, k, v, s0, z0):
+        out, (sf, zf) = linear_attention_pallas_fused(
+            q, k, v, chunk=8, initial_state=(s0, z0),
+            return_state=True, interpret=True,
+        )
+        return jnp.sum(out) + jnp.sum(sf * wS) + jnp.sum(zf)
+
+    def loss_x(q, k, v, s0, z0):
+        out, (sf, zf) = linear_attention(
+            q, k, v, backend="xla", chunk=8, initial_state=(s0, z0),
+            return_state=True,
+        )
+        return jnp.sum(out) + jnp.sum(sf * wS) + jnp.sum(zf)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2, 3, 4))(q, k, v, s0, z0)
+    gx = jax.grad(loss_x, argnums=(0, 1, 2, 3, 4))(q, k, v, s0, z0)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+
+def test_fused_bf16():
+    q, k, v = _inputs(jax.random.PRNGKey(7), 2, 2, 32, 8, dtype=jnp.bfloat16)
+    ref = linear_attention(q, k, v, backend="xla", chunk=16)
+    got = linear_attention_pallas_fused(q, k, v, chunk=16, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        got.astype(np.float32), ref.astype(np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_dispatch_routes_to_fused():
+    q, k, v = _inputs(jax.random.PRNGKey(8), 1, 1, 16, 8)
+    a = linear_attention(q, k, v, backend="xla", chunk=8)
+    b = linear_attention(q, k, v, backend="pallas_interpret", chunk=8)
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
