@@ -19,4 +19,28 @@ __version__ = "0.1.0"
 
 from orion_tpu import ops
 
-__all__ = ["ops", "__version__"]
+# Lazy top-level API: heavy submodules (training pulls optax/orbax, generate
+# pulls models) load on first use, keeping `import orion_tpu` light.
+_LAZY = {
+    "train": ("orion_tpu.train", "train"),
+    "TrainConfig": ("orion_tpu.training.trainer", "TrainConfig"),
+    "Trainer": ("orion_tpu.training.trainer", "Trainer"),
+    "generate": ("orion_tpu.generate", "generate"),
+    "SampleConfig": ("orion_tpu.generate", "SampleConfig"),
+    "TransformerLM": ("orion_tpu.models.transformer", "TransformerLM"),
+    "LRAClassifier": ("orion_tpu.models.classifier", "LRAClassifier"),
+    "ModelConfig": ("orion_tpu.models.configs", "ModelConfig"),
+    "get_config": ("orion_tpu.models.configs", "get_config"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'orion_tpu' has no attribute {name!r}")
+
+
+__all__ = ["ops", "__version__", *_LAZY]

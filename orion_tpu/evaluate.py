@@ -1,0 +1,76 @@
+"""`python -m orion_tpu.evaluate` — held-out perplexity evaluation
+(SURVEY.md T7).
+
+Loads a training checkpoint and reports loss/perplexity over N batches of a
+token-bin dataset (or the synthetic stream). Library: ``evaluate_lm(...)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from orion_tpu.models.configs import get_config
+from orion_tpu.models.transformer import TransformerLM
+from orion_tpu.training.data import make_dataset
+
+
+def evaluate_lm(
+    model: TransformerLM,
+    params,
+    dataset,
+    batch_size: int = 8,
+    n_batches: int = 16,
+    seed: int = 123,
+) -> dict:
+    @jax.jit
+    def eval_step(params, batch):
+        x, y = batch[:, :-1], batch[:, 1:]
+        logits = model.apply(params, x)
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        return losses.sum(), jnp.asarray(losses.size, jnp.float32)
+
+    total, count = 0.0, 0.0
+    for i in range(n_batches):
+        batch = jnp.asarray(dataset.batch(seed, i, batch_size))
+        s, c = eval_step(params, batch)
+        total += float(s)
+        count += float(c)
+    loss = total / max(count, 1.0)
+    return {
+        "eval_loss": loss,
+        "eval_ppl": float(jnp.exp(jnp.minimum(loss, 20.0))),
+        "tokens": int(count),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("orion_tpu.evaluate")
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--data", default="synthetic")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--n-batches", type=int, default=16)
+    args = p.parse_args(argv)
+
+    from orion_tpu.generate import load_params
+
+    cfg = get_config(args.config)
+    model = TransformerLM(cfg)
+    params, step = load_params(args.ckpt_dir, args.step)
+    dataset = make_dataset(args.data, args.seq_len, cfg.vocab_size)
+    res = evaluate_lm(model, params, dataset, args.batch_size, args.n_batches)
+    res["step"] = step
+    print(res)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,22 +59,24 @@ def train(
         sharding=trainer.batch_shd,
     )
     logger = MetricsLogger(log_path)
+    eval_loader = None
+    if cfg.eval_every:
+        eval_loader = DataLoader(
+            dataset, cfg.batch_size, seed=cfg.seed + 1,
+            start_step=10_000_000, sharding=trainer.batch_shd,
+        )
     try:
-        last = trainer.train(iter(loader), logger=logger, ckpt=ckpt)
-        if cfg.eval_every:
-            eval_loader = DataLoader(
-                dataset, cfg.batch_size, seed=cfg.seed + 1,
-                start_step=10_000_000, sharding=trainer.batch_shd,
-            )
-            try:
-                last.update(trainer.evaluate(iter(eval_loader)))
-            finally:
-                eval_loader.close()
+        last = trainer.train(
+            iter(loader), logger=logger, ckpt=ckpt,
+            eval_iter=iter(eval_loader) if eval_loader else None,
+        )
         if ckpt is not None:
             ckpt.maybe_save(int(trainer.state.step), trainer.state, force=True)
             ckpt.wait()
     finally:
         loader.close()
+        if eval_loader is not None:
+            eval_loader.close()
         logger.close()
     return trainer.state, last
 

@@ -268,8 +268,11 @@ class Trainer:
         self.state, metrics = self._step_fn(self.state, batch)
         return metrics
 
-    def train(self, data_iter, logger=None, ckpt=None, hook=None) -> Dict[str, float]:
-        """Run cfg.steps - state.step steps. Returns last metrics (host)."""
+    def train(
+        self, data_iter, logger=None, ckpt=None, hook=None, eval_iter=None
+    ) -> Dict[str, float]:
+        """Run cfg.steps - state.step steps. Returns last metrics (host).
+        ``eval_iter`` + cfg.eval_every > 0 interleaves held-out evals."""
         cfg = self.cfg
         tokens_per_step = cfg.batch_size * cfg.seq_len
         last: Dict[str, float] = {}
@@ -290,6 +293,15 @@ class Trainer:
                 last["ppl"] = float(jnp.exp(jnp.minimum(last["loss"], 20.0)))
                 if logger:
                     logger.log(step, last, tokens_per_step)
+            if (
+                eval_iter is not None
+                and cfg.eval_every
+                and (step % cfg.eval_every == 0 or step == cfg.steps)
+            ):
+                ev = self.evaluate(eval_iter)
+                last.update(ev)
+                if logger:
+                    logger.log(step, ev)
             if ckpt is not None:
                 ckpt.maybe_save(step, self.state)
             if hook is not None:

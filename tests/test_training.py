@@ -184,3 +184,33 @@ def test_lion_optimizer_runs():
     ds = SyntheticDataset(cfg.model.vocab_size, cfg.seq_len)
     last = trainer.train(_iter(ds, cfg))
     assert np.isfinite(last["loss"])
+
+
+def test_periodic_eval_during_train():
+    cfg = small_cfg(steps=6, eval_every=3, eval_batches=2)
+    trainer = Trainer(cfg)
+    ds = SyntheticDataset(cfg.model.vocab_size, cfg.seq_len)
+    last = trainer.train(_iter(ds, cfg), eval_iter=_iter(ds, cfg, start=500))
+    assert "eval_loss" in last and np.isfinite(last["eval_loss"])
+
+
+def test_evaluate_cli_roundtrip(tmp_path):
+    """train -> checkpoint -> evaluate_lm reads it back."""
+    from orion_tpu.evaluate import evaluate_lm
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.training.checkpoint import Checkpointer
+
+    cfg = small_cfg(steps=3, ckpt_dir=str(tmp_path / "ck"), ckpt_every=3)
+    trainer = Trainer(cfg)
+    ds = SyntheticDataset(cfg.model.vocab_size, cfg.seq_len)
+    ckpt = Checkpointer(cfg.ckpt_dir, save_every=3, async_save=False)
+    trainer.train(_iter(ds, cfg), ckpt=ckpt)
+    ckpt.close()
+
+    from orion_tpu.generate import load_params
+
+    params, step = load_params(cfg.ckpt_dir)
+    assert step == 3
+    model = TransformerLM(cfg.model)
+    res = evaluate_lm(model, params, ds, batch_size=2, n_batches=2)
+    assert np.isfinite(res["eval_loss"]) and res["tokens"] > 0
