@@ -38,6 +38,8 @@ class SampleConfig:
     temperature: float = 1.0
     top_k: int = 0  # 0 = off
     top_p: float = 1.0  # 1.0 = off
+    eos_token: int = -1  # >= 0: stop sequences at EOS (pad with pad_token)
+    pad_token: int = 0
 
     @property
     def greedy(self) -> bool:
@@ -77,18 +79,26 @@ def _generate_jit(
 ) -> Array:
     """prompt [B, T0] -> generated [B, max_new_tokens]."""
     t0 = prompt.shape[1]
+    use_eos = sample_cfg.eos_token >= 0
     logits, states = model.apply(params, prompt, method="prefill")
     first = sample_logits(logits[:, -1], jax.random.fold_in(rng, 0), sample_cfg)
+    done0 = jnp.zeros(first.shape, bool)
 
     def body(carry, i):
-        token, states, t = carry
+        token, states, t, done = carry
         logits, states = model.apply(params, token, states, t, method="decode_step")
         nxt = sample_logits(logits, jax.random.fold_in(rng, i + 1), sample_cfg)
-        return (nxt, states, t + 1), token
+        if use_eos:
+            # emit EOS itself, pad everything after it
+            emitted = jnp.where(done, sample_cfg.pad_token, token)
+            done = done | (emitted == sample_cfg.eos_token)
+        else:
+            emitted = token
+        return (nxt, states, t + 1, done), emitted
 
-    (_, _, _), tokens = jax.lax.scan(
+    (_, _, _, _), tokens = jax.lax.scan(
         body,
-        (first, states, jnp.int32(t0)),
+        (first, states, jnp.int32(t0), done0),
         jnp.arange(max_new_tokens),
         length=max_new_tokens,
     )

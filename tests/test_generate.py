@@ -120,3 +120,34 @@ def test_byte_tokenizer_roundtrip():
     tok = ByteTokenizer()
     s = "hello, κόσμε ✓"
     assert tok.decode(tok.encode(s)) == s
+
+
+def test_eos_stops_and_pads():
+    """Force EOS = the greedy-argmax token at some step; everything after the
+    first EOS emission must be pad."""
+    model, params = _model_and_params()
+    prompt = jnp.ones((2, 4), jnp.int32)
+    base = generate(model, params, prompt, 8, SampleConfig(temperature=0.0))
+    eos = int(np.asarray(base[0, 2]))  # the token greedily emitted at step 2
+    out = generate(
+        model, params, prompt, 8,
+        SampleConfig(temperature=0.0, eos_token=eos, pad_token=0),
+    )
+    row = np.asarray(out[0])
+    eos_positions = np.where(row == eos)[0]
+    assert len(eos_positions) >= 1
+    first_eos = eos_positions[0]
+    assert (row[first_eos + 1 :] == 0).all()
+    # tokens before EOS are unchanged vs the no-EOS run
+    np.testing.assert_array_equal(row[: first_eos + 1],
+                                  np.asarray(base[0])[: first_eos + 1])
+
+
+def test_profiling_step_timer():
+    from orion_tpu.utils.profiling import StepTimer
+
+    t = StepTimer(tokens_per_step=100)
+    for _ in range(5):
+        t.mark()
+    s = t.summary()
+    assert s["steps"] == 4 and s["p50_ms"] >= 0 and "tokens_per_sec" in s
