@@ -239,7 +239,7 @@ def _kernel_norm(
 
     den_intra = jnp.sum(scores, axis=1, keepdims=True)  # (C, 1)
     den_inter = jax.lax.dot_general(
-        qi, z_scr[:],
+        qi.astype(jnp.float32), z_scr[:],  # same-dtype operands for Mosaic
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # (C, 1)

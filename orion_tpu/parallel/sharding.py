@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _RULES = (
     (r"(wq|wk|wv|gate|up|phi_proj)/kernel$", P("fsdp", "tp")),
     (r"(wo|down)/kernel$", P("tp", "fsdp")),
-    (r"lm_head/kernel$", P("fsdp", "tp")),
+    (r"lm_head_kernel$", P("fsdp", "tp")),
     (r"head/kernel$", P("fsdp", None)),
     (r"(embed|embedding|pos_embed)/embedding$", P(None, "fsdp")),
     (r"favor_proj$", P(None, None)),
