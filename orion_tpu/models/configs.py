@@ -47,6 +47,7 @@ class ModelConfig:
     backend: str = "auto"  # kernel dispatch for attention ops
     chunk: int = 128  # linear-attn chunk size
     remat: bool = False  # per-block activation checkpointing
+    remat_policy: str = "full"  # "full" | "dots" (save matmul outputs)
     # sequence/context parallelism: when True and the model is built with a
     # mesh whose sp axis > 1, causal attention runs sharded over tokens —
     # linear layers via the kv-state exclusive prefix (parallel/sequence.py),

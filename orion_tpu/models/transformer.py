@@ -325,7 +325,13 @@ class TransformerLM(nn.Module):
         self.pos_embed = nn.Embed(cfg.max_seq_len, cfg.d_model, param_dtype=pdt)
         block_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, static_argnums=(3,))
+            policies = {
+                "full": None,  # save only block boundaries, recompute all
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+            }
+            block_cls = nn.remat(
+                Block, static_argnums=(3,), policy=policies[cfg.remat_policy]
+            )
         self.blocks = [
             block_cls(cfg, lt, True, self.mesh, name=f"block_{i}")
             for i, lt in enumerate(cfg.resolved_layer_types)
