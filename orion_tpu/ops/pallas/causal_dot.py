@@ -299,22 +299,25 @@ def _cdpn_flat(q, k, v, s0, z0, chunk, interpret):
 def _lin_attn_fused(q, k, v, s0, z0, chunk, eps, interpret):
     num, den, sf, zf = _cdpn_flat(q, k, v, s0, z0, chunk, interpret)
     out = (num / (den + eps)).astype(q.dtype)
-    return out, sf, zf
+    return out, sf, zf, den
 
 
 def _lin_attn_fused_fwd(q, k, v, s0, z0, chunk, eps, interpret):
     num, den, sf, zf = _cdpn_flat(q, k, v, s0, z0, chunk, interpret)
     out = (num / (den + eps)).astype(q.dtype)
-    return (out, sf, zf), (q, k, v, s0, z0, num, den)
+    return (out, sf, zf, den), (q, k, v, s0, z0, num, den)
 
 
 def _lin_attn_fused_bwd(chunk, eps, interpret, res, cts):
     q, k, v, s0, z0, num, den = res
-    gout, gsf, gzf = cts
+    gout, gsf, gzf, gden_ext = cts
     gout = gout.astype(jnp.float32)
     d = den + eps  # (BH, T, 1) fp32
     gnum = (gout / d).astype(q.dtype)
-    gden = -jnp.sum(gout * num, axis=-1, keepdims=True) / (d * d)  # (BH, T, 1)
+    gden = (
+        -jnp.sum(gout * num, axis=-1, keepdims=True) / (d * d)
+        + gden_ext.astype(jnp.float32)
+    )  # (BH, T, 1)
     gsf32 = gsf.astype(jnp.float32)
 
     # numerator part: the time-flip kernel identities (see module docstring)
@@ -376,9 +379,15 @@ def linear_attention_pallas_fused(
     eps: float = 1e-6,
     initial_state: Optional[Tuple[Array, Array]] = None,
     return_state: bool = False,
+    return_den: bool = False,
     interpret: bool = False,
 ):
     """Normalized causal linear attention, fully fused in one Pallas pass.
+
+    ``return_den`` additionally returns the fp32 normalizer den[t] =
+    q_t·(z0 + Σ_{s<=t} k_s) as [..., T] — what lets sequence parallelism
+    correct a locally-normalized shard in O(T·D) after one kernel pass
+    (parallel/sequence.py).
 
     out[t] = q_t·S_t / (q_t·z_t + eps) with S, z the kv-cumsum states;
     optionally seeded by ``initial_state=(S0 [..,Dk,Dv], z0 [..,Dk])`` and
@@ -407,14 +416,16 @@ def linear_attention_pallas_fused(
         s0 = initial_state[0].astype(jnp.float32).reshape(bh, dk, dv)
         z0 = initial_state[1].astype(jnp.float32).reshape(bh, 1, dk)
 
-    out, sf, zf = _lin_attn_fused(qf, kf, vf, s0, z0, chunk, eps, interpret)
+    out, sf, zf, den = _lin_attn_fused(qf, kf, vf, s0, z0, chunk, eps, interpret)
     out = out[:, :t, :].reshape(*batch_shape, t, dv)
+    results = [out]
     if return_state:
-        return out, (
-            sf.reshape(*batch_shape, dk, dv),
-            zf.reshape(*batch_shape, dk),
+        results.append(
+            (sf.reshape(*batch_shape, dk, dv), zf.reshape(*batch_shape, dk))
         )
-    return out
+    if return_den:
+        results.append(den[:, :t, 0].reshape(*batch_shape, t))
+    return results[0] if len(results) == 1 else tuple(results)
 
 
 __all__ = ["causal_dot_product_pallas", "linear_attention_pallas_fused"]
