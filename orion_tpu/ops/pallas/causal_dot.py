@@ -308,16 +308,10 @@ def _lin_attn_fused_fwd(q, k, v, s0, z0, chunk, eps, interpret):
     return (out, sf, zf, den), (q, k, v, s0, z0, num, den)
 
 
-def _lin_attn_fused_bwd(chunk, eps, interpret, res, cts):
-    q, k, v, s0, z0, num, den = res
-    gout, gsf, gzf, gden_ext = cts
-    gout = gout.astype(jnp.float32)
-    d = den + eps  # (BH, T, 1) fp32
-    gnum = (gout / d).astype(q.dtype)
-    gden = (
-        -jnp.sum(gout * num, axis=-1, keepdims=True) / (d * d)
-        + gden_ext.astype(jnp.float32)
-    )  # (BH, T, 1)
+def _fused_bwd_core(q, k, v, s0, z0, gnum, gden, gsf, gzf, chunk, interpret):
+    """Shared backward for the fused pass given cotangents of the fp32
+    numerator (gnum, already cast to q.dtype for the kernel), denominator
+    (gden [BH,T,1] fp32), and final states (gsf, gzf)."""
     gsf32 = gsf.astype(jnp.float32)
 
     # numerator part: the time-flip kernel identities (see module docstring)
@@ -367,7 +361,71 @@ def _lin_attn_fused_bwd(chunk, eps, interpret, res, cts):
     )
 
 
+def _lin_attn_fused_bwd(chunk, eps, interpret, res, cts):
+    q, k, v, s0, z0, num, den = res
+    gout, gsf, gzf, gden_ext = cts
+    gout = gout.astype(jnp.float32)
+    d = den + eps  # (BH, T, 1) fp32
+    gnum = (gout / d).astype(q.dtype)
+    gden = (
+        -jnp.sum(gout * num, axis=-1, keepdims=True) / (d * d)
+        + gden_ext.astype(jnp.float32)
+    )  # (BH, T, 1)
+    return _fused_bwd_core(q, k, v, s0, z0, gnum, gden, gsf, gzf, chunk, interpret)
+
+
 _lin_attn_fused.defvjp(_lin_attn_fused_fwd, _lin_attn_fused_bwd)
+
+
+# Raw (unnormalized) fused pass: hands back the fp32 numerator itself, so
+# sequence parallelism can apply the cross-shard prefix correction without a
+# bf16 round-trip through the normalized output (ADVICE r1).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _lin_attn_fused_raw(q, k, v, s0, z0, chunk, interpret):
+    return _cdpn_flat(q, k, v, s0, z0, chunk, interpret)
+
+
+def _lin_attn_fused_raw_fwd(q, k, v, s0, z0, chunk, interpret):
+    num, den, sf, zf = _cdpn_flat(q, k, v, s0, z0, chunk, interpret)
+    return (num, den, sf, zf), (q, k, v, s0, z0)
+
+
+def _lin_attn_fused_raw_bwd(chunk, interpret, res, cts):
+    q, k, v, s0, z0 = res
+    gnum32, gden, gsf, gzf = cts
+    gnum = gnum32.astype(q.dtype)
+    gden = gden.astype(jnp.float32)
+    return _fused_bwd_core(q, k, v, s0, z0, gnum, gden, gsf, gzf, chunk, interpret)
+
+
+_lin_attn_fused_raw.defvjp(_lin_attn_fused_raw_fwd, _lin_attn_fused_raw_bwd)
+
+
+def _prep_fused(q, k, v, chunk, initial_state):
+    """Shared flatten + tail-pad + state-init for the fused entry points.
+    Returns (qf, kf, vf, s0, z0, batch_shape, t)."""
+    batch_shape = q.shape[:-2]
+    t, dk = q.shape[-2], q.shape[-1]
+    dv = v.shape[-1]
+    bh = 1
+    for s in batch_shape:
+        bh *= s
+
+    qf = q.reshape(bh, t, dk)
+    kf = k.reshape(bh, t, dk)
+    vf = v.reshape(bh, t, dv)
+    rem = (-t) % chunk
+    if rem:
+        pad = ((0, 0), (0, rem), (0, 0))
+        qf, kf, vf = jnp.pad(qf, pad), jnp.pad(kf, pad), jnp.pad(vf, pad)
+
+    if initial_state is None:
+        s0 = jnp.zeros((bh, dk, dv), jnp.float32)
+        z0 = jnp.zeros((bh, 1, dk), jnp.float32)
+    else:
+        s0 = initial_state[0].astype(jnp.float32).reshape(bh, dk, dv)
+        z0 = initial_state[1].astype(jnp.float32).reshape(bh, 1, dk)
+    return qf, kf, vf, s0, z0, batch_shape, t
 
 
 def linear_attention_pallas_fused(
@@ -394,27 +452,8 @@ def linear_attention_pallas_fused(
     returning the final (S, z) — the prefill→decode handoff. Differentiable
     through everything including the states (custom VJP: kernel passes for
     the numerator, O(T·Dk) cumsums for the denominator)."""
-    batch_shape = q.shape[:-2]
-    t, dk = q.shape[-2], q.shape[-1]
-    dv = v.shape[-1]
-    bh = 1
-    for s in batch_shape:
-        bh *= s
-
-    qf = q.reshape(bh, t, dk)
-    kf = k.reshape(bh, t, dk)
-    vf = v.reshape(bh, t, dv)
-    rem = (-t) % chunk
-    if rem:
-        pad = ((0, 0), (0, rem), (0, 0))
-        qf, kf, vf = jnp.pad(qf, pad), jnp.pad(kf, pad), jnp.pad(vf, pad)
-
-    if initial_state is None:
-        s0 = jnp.zeros((bh, dk, dv), jnp.float32)
-        z0 = jnp.zeros((bh, 1, dk), jnp.float32)
-    else:
-        s0 = initial_state[0].astype(jnp.float32).reshape(bh, dk, dv)
-        z0 = initial_state[1].astype(jnp.float32).reshape(bh, 1, dk)
+    qf, kf, vf, s0, z0, batch_shape, t = _prep_fused(q, k, v, chunk, initial_state)
+    dk, dv = q.shape[-1], v.shape[-1]
 
     out, sf, zf, den = _lin_attn_fused(qf, kf, vf, s0, z0, chunk, eps, interpret)
     out = out[:, :t, :].reshape(*batch_shape, t, dv)
@@ -428,4 +467,35 @@ def linear_attention_pallas_fused(
     return results[0] if len(results) == 1 else tuple(results)
 
 
-__all__ = ["causal_dot_product_pallas", "linear_attention_pallas_fused"]
+def linear_attention_pallas_parts(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    chunk: int = 128,
+    initial_state: Optional[Tuple[Array, Array]] = None,
+    interpret: bool = False,
+):
+    """One fused kernel pass, returning the raw fp32 parts:
+    (num [..., T, Dv] fp32, den [..., T] fp32, (S [..,Dk,Dv], z [..,Dk])).
+
+    The sequence-parallel path (parallel/sequence.py) consumes these: the
+    exact fp32 numerator lets the cross-shard prefix correction avoid
+    inheriting bf16 rounding from the locally-normalized output.
+    Differentiable via custom VJP (same kernel identities, no quotient
+    rule needed)."""
+    qf, kf, vf, s0, z0, batch_shape, t = _prep_fused(q, k, v, chunk, initial_state)
+    dk, dv = q.shape[-1], v.shape[-1]
+
+    num, den, sf, zf = _lin_attn_fused_raw(qf, kf, vf, s0, z0, chunk, interpret)
+    num = num[:, :t, :].reshape(*batch_shape, t, dv)
+    den = den[:, :t, 0].reshape(*batch_shape, t)
+    state = (sf.reshape(*batch_shape, dk, dv), zf.reshape(*batch_shape, dk))
+    return num, den, state
+
+
+__all__ = [
+    "causal_dot_product_pallas",
+    "linear_attention_pallas_fused",
+    "linear_attention_pallas_parts",
+]

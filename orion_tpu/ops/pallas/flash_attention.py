@@ -366,8 +366,13 @@ def flash_attention(
     bh = 1
     for s in batch_shape:
         bh *= s
-    bq = min(block_q, max(t_q, 8))
-    bk = min(block_k, max(t_k, 8))
+    # clamp to the sequence length, then round up to the TPU sublane tile
+    # (8 rows fp32, 16 bf16) — Mosaic may reject/deoptimize ragged blocks;
+    # the existing tail padding + t_k masking absorbs the overshoot
+    tile = 16 if q.dtype == jnp.bfloat16 else 8
+    rup = lambda x: -(-x // tile) * tile  # noqa: E731
+    bq = rup(min(block_q, max(t_q, 8)))
+    bk = rup(min(block_k, max(t_k, 8)))
     out = _flash(
         q.reshape(bh, t_q, d),
         k.reshape(bh, t_k, d),

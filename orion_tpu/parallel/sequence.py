@@ -66,28 +66,28 @@ def sp_linear_attention_local(
     """The shard_map body: q,k,v are the LOCAL [.., T/sp, D] shards (post
     feature map). Normalized causal linear attention, exact across shards.
 
-    Pallas backend — ONE fused kernel pass: the kernel hands back the local
-    output, its normalizer den, and the shard's (S, z); the cross-shard
-    prefix then corrects in O(T·D) elementwise/matvec work:
-        num_full = out_loc·(den_loc+eps) + q @ S_prefix
+    Pallas backend — ONE fused kernel pass: the kernel hands back the raw
+    fp32 numerator, its normalizer den, and the shard's (S, z); the
+    cross-shard prefix then corrects in O(T·D) elementwise/matvec work:
+        num_full = num_loc + q @ S_prefix
         out_full = num_full / (den_loc + q·z_prefix + eps)
+    (The fp32 numerator comes straight from the kernel — no reconstruction
+    from the bf16-rounded output.)
     XLA backend — two passes (local states, then state-seeded attention).
     """
     from orion_tpu.ops.dispatch import resolve
 
     b = resolve(backend)
     if b in ("pallas", "pallas_interpret"):
-        from orion_tpu.ops.pallas.causal_dot import linear_attention_pallas_fused
+        from orion_tpu.ops.pallas.causal_dot import linear_attention_pallas_parts
 
-        out_loc, (s_loc, z_loc), den_loc = linear_attention_pallas_fused(
-            q, k, v, chunk=chunk, eps=eps, return_state=True, return_den=True,
-            interpret=(b == "pallas_interpret"),
+        num_loc, den_loc, (s_loc, z_loc) = linear_attention_pallas_parts(
+            q, k, v, chunk=chunk, interpret=(b == "pallas_interpret"),
         )
         s0 = _exclusive_prefix(s_loc, axis)
         z0 = _exclusive_prefix(z_loc, axis)
         qf = q.astype(jnp.float32)
-        num = out_loc.astype(jnp.float32) * (den_loc + eps)[..., None]
-        num = num + jnp.einsum("...td,...de->...te", qf, s0)
+        num = num_loc + jnp.einsum("...td,...de->...te", qf, s0)
         den = den_loc + jnp.einsum("...td,...d->...t", qf, z0)
         return (num / (den + eps)[..., None]).astype(q.dtype)
 

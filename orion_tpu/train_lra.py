@@ -45,27 +45,39 @@ from orion_tpu.utils import rng as rngs
 
 
 class SyntheticListOps:
-    """Sequences of digit tokens (0-9) with bracket markers; label = result
-    of a running max/min/median-style reduction — long-range because early
-    operators scope the whole suffix. Tokens: 0-9 digits, 10 '[MAX', 11
-    '[MIN', 12 ']'. n_classes=10."""
+    """Nested two-level reduction with the structure of real ListOps:
+    ``[MAX [MIN d d d d  [MIN d d d d  ...`` — each group reduces its four
+    digits by MIN, and the outer MAX at position 0 reduces the group values.
+    The label depends only on the digits (no operator-detection shortcut:
+    ops are constant) and requires aggregating locally-reduced values across
+    the whole sequence. A flat max/min over ~T uniform digits would be 9
+    (or 0) with probability →1 (the ADVICE r1 degeneracy); min over 4 stays
+    spread, and max-of-mins is distributed over ~6 classes (majority class
+    ≈0.27). Tokens: 0-9 digits, 10 '[MAX', 11 '[MIN', 12 ']'. n_classes=10."""
 
     vocab_size = 16
     n_classes = 10
+    group = 4  # digits per inner MIN group — keeps the label non-degenerate
 
     def __init__(self, seq_len: int):
+        if seq_len < 3:  # pos 0 outer op + 1 inner op + >=1 digit
+            raise ValueError(f"SyntheticListOps needs seq_len >= 3, got {seq_len}")
         self.seq_len = seq_len
 
     def batch(self, seed: int, step: int, b: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        rng = np.random.Generator(np.random.Philox(key=seed, counter=step))
+        rng = np.random.Generator(np.random.Philox(key=[seed, step]))
         t = self.seq_len
+        g = min(self.group, t - 2)
         toks = rng.integers(0, 10, size=(b, t))
-        ops = rng.integers(10, 12, size=(b,))
-        toks[:, 0] = ops  # operator at position 0 scopes the whole sequence
-        digits = toks[:, 1:]
-        labels = np.where(
-            ops == 10, digits.max(axis=1), digits.min(axis=1)
-        ).astype(np.int32)
+        toks[:, 0] = 10  # outer [MAX scopes the whole sequence
+        starts = np.arange(1, t - g, g + 1)
+        if starts.size == 0:  # tiny sequences: one group filling the tail
+            starts = np.array([1])
+            g = t - 2
+        toks[:, starts] = 11  # [MIN opens each inner group
+        gidx = starts[:, None] + 1 + np.arange(g)[None, :]  # (m, g)
+        digits = toks[:, gidx]  # (b, m, g)
+        labels = digits.min(axis=-1).max(axis=-1).astype(np.int32)
         mask = np.ones((b, t), dtype=bool)
         return toks.astype(np.int32), labels, mask
 
@@ -81,7 +93,7 @@ class SyntheticText:
         self.seq_len = seq_len
 
     def batch(self, seed: int, step: int, b: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        rng = np.random.Generator(np.random.Philox(key=seed, counter=step))
+        rng = np.random.Generator(np.random.Philox(key=[seed, step]))
         t = self.seq_len
         toks = rng.integers(0, 32, size=(b, t)).astype(np.int32)
         half = t // 2
@@ -112,7 +124,7 @@ class TSVDataset:
                 self.samples.append((int(label), ids))
 
     def batch(self, seed: int, step: int, b: int):
-        rng = np.random.Generator(np.random.Philox(key=seed, counter=step))
+        rng = np.random.Generator(np.random.Philox(key=[seed, step]))
         idx = rng.integers(0, len(self.samples), size=b)
         toks = np.zeros((b, self.seq_len), dtype=np.int32)
         mask = np.zeros((b, self.seq_len), dtype=bool)

@@ -109,7 +109,7 @@ class SyntheticDataset:
         self.seq_len = seq_len
 
     def batch(self, seed: int, step: int, batch_size: int) -> np.ndarray:
-        rng = np.random.Generator(np.random.Philox(key=seed, counter=step))
+        rng = np.random.Generator(np.random.Philox(key=[seed, step]))
         t = self.seq_len + 1
         out = np.empty((batch_size, t), dtype=np.int32)
         out[:, 0] = rng.integers(0, self.vocab_size, size=batch_size)

@@ -12,8 +12,10 @@ bf16 (model cfg.dtype), logits + loss + grads fp32 master.
 
 Failure detection (A2): each step computes finite = isfinite(loss) &
 isfinite(grad_norm); on a bad step the update is skipped tree-wide
-(params/opt state keep their old values) and ``nonfinite`` counts it.
-``nan_policy="halt"`` makes the host loop raise instead.
+(params/opt state keep their old values). A cumulative skip counter is
+carried device-side in TrainState, so the host reads it only at log
+cadence yet no bad step between log points is missed; ``nan_policy="halt"``
+raises at the next log point if the counter advanced.
 """
 
 from __future__ import annotations
@@ -81,6 +83,9 @@ class TrainState(struct.PyTreeNode):
     params: Any
     opt_state: Any
     rng: Array
+    # cumulative count of skipped non-finite steps, carried device-side so the
+    # host only reads it at log cadence yet no bad step is ever missed (A2)
+    nonfinite: Array
 
 
 def make_schedule(cfg: TrainConfig):
@@ -151,6 +156,14 @@ def lm_loss(model: TransformerLM, params, batch: Array, dropout_rng=None):
 
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh: Optional[Mesh] = None):
+        # fail loudly: out-of-range positions would be silently clamped by
+        # XLA gather, yielding wrong position embeddings (train.py's CLI
+        # auto-bumps max_seq_len; the library path must not rely on that)
+        if cfg.seq_len > cfg.model.max_seq_len:
+            raise ValueError(
+                f"seq_len={cfg.seq_len} exceeds model.max_seq_len="
+                f"{cfg.model.max_seq_len}; raise max_seq_len or lower seq_len"
+            )
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
         self.model = TransformerLM(
@@ -173,6 +186,7 @@ class Trainer:
                 params=params,
                 opt_state=self.tx.init(params),
                 rng=self._dropout_rng,
+                nonfinite=jnp.zeros((), jnp.int32),
             )
 
         abstract = jax.eval_shape(init_fn, self._init_rng)
@@ -242,17 +256,20 @@ class Trainer:
         sel = lambda new, old: jax.tree.map(  # noqa: E731
             lambda n, o: jnp.where(finite, n, o), new, old
         )
+        bad = (~finite).astype(jnp.int32)
         new_state = TrainState(
             step=state.step + 1,
             params=sel(new_params, state.params),
             opt_state=sel(new_opt, state.opt_state),
             rng=state.rng,
+            nonfinite=state.nonfinite + bad,
         )
         metrics = {
             "loss": loss,
             "grad_norm": gnorm,
             "lr": self.sched(state.step),
-            "nonfinite": (~finite).astype(jnp.int32),
+            "nonfinite": bad,
+            "nonfinite_total": new_state.nonfinite,
         }
         return new_state, metrics
 
@@ -283,11 +300,14 @@ class Trainer:
             # only materialize metrics on the host at log cadence — reading a
             # device scalar every step would serialize the pipeline
             if step % cfg.log_every == 0 or step == cfg.steps:
-                if metrics["nonfinite"]:
-                    self.nonfinite_steps += int(metrics["nonfinite"])
+                # cumulative device-side counter: catches non-finite steps
+                # that happened *between* log points too
+                nf_total = int(metrics["nonfinite_total"])
+                if nf_total > self.nonfinite_steps:
+                    self.nonfinite_steps = nf_total
                     if cfg.nan_policy == "halt":
                         raise FloatingPointError(
-                            f"non-finite loss/grads at step {step}"
+                            f"{nf_total} non-finite step(s) by step {step}"
                         )
                 last = {k: float(v) for k, v in metrics.items()}
                 last["ppl"] = float(jnp.exp(jnp.minimum(last["loss"], 20.0)))
@@ -331,6 +351,9 @@ class Trainer:
 
     def restore(self, ckpt, step: Optional[int] = None):
         self.state = ckpt.restore(self.abstract_state(), step)
+        # sync the host-side counter so halt-mode doesn't re-raise for bad
+        # steps that happened (and were handled) before the checkpoint
+        self.nonfinite_steps = int(self.state.nonfinite)
         return int(self.state.step)
 
 
