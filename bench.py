@@ -2,37 +2,39 @@
 (the BASELINE.json metric), on whatever single chip is available.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N,
+     "mfu": F}
 
 ``vs_baseline`` is the ratio against BENCH_BASELINE.json (the first recorded
 round-1 number — BASELINE.json.published was empty and the reference
 checkout was never mounted, so there is no reference number to compare to;
 see BASELINE.md). Ratio > 1.0 = faster than round 1.
 
-A recurrent-decode latency figure (the second BASELINE.json metric) is
-printed to stderr alongside, not as the headline line.
+Secondary figures go to stderr as JSON lines: recurrent-decode p50 latency
+(tiny + lm_1b3 — the second BASELINE.json metric) and, with ``--kernels``,
+the Pallas-vs-XLA kernel micro-bench table (orion_tpu/bench_kernels.py).
+
+Timing: every measurement ends in a device→host readback —
+``jax.block_until_ready`` is NOT a real barrier through this environment's
+TPU relay (measured: chained 8192³ matmuls "complete" in 0.02 ms).
 """
 
 from __future__ import annotations
 
+import argparse
 import dataclasses
 import json
 import os
 import sys
 import time
 
+V5E_PEAK_FLOPS = 197e12  # bf16
+
 
 def _enable_compile_cache():
-    """Persistent XLA compilation cache: the 1.3B step takes minutes to
-    compile; cache it across bench invocations."""
-    import jax
+    from orion_tpu.utils.cache import enable_compile_cache
 
-    cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-    except Exception:
-        pass
+    enable_compile_cache(os.path.join(os.path.dirname(__file__), ".jax_cache"))
 
 
 def _build(batch_size: int, seq_len: int):
@@ -51,8 +53,10 @@ def _build(batch_size: int, seq_len: int):
         steps=10**9,
         batch_size=batch_size,
         seq_len=seq_len,
-        optimizer="lion",      # one moment: the 1.3B step fits in 16GB HBM
-        mu_dtype="bfloat16",
+        # adafactor's factored state frees ~2.6GB vs Lion's bf16 moment on
+        # the 16GB chip — what lets batch 16 fit (BENCH r2 sweep)
+        optimizer="adafactor",
+        mu_dtype=None,
         lr=1e-4,
         warmup_steps=10,
         mesh=MeshConfig(dp=1),
@@ -65,69 +69,111 @@ def _build(batch_size: int, seq_len: int):
     return trainer, batch
 
 
-def bench_train(seq_len: int = 2048, iters: int = 10) -> dict:
+def _n_params(trainer) -> float:
     import jax
 
+    return float(
+        sum(x.size for x in jax.tree.leaves(trainer.state.params))
+    )
+
+
+def bench_train(seq_len: int = 2048, iters: int = 10) -> dict:
     last_err = None
-    for batch_size in (8, 4, 2, 1):
+    for batch_size in (16, 8, 4, 2, 1):
         try:
             trainer, batch = _build(batch_size, seq_len)
-            trainer.step(batch)  # compile + 1 step
-            trainer.step(batch)  # warm
-            jax.block_until_ready(trainer.state.params)
+            m = trainer.step(batch)  # compile + 1 step
+            m = trainer.step(batch)  # warm
+            float(m["loss"])  # readback barrier
             t0 = time.perf_counter()
             for _ in range(iters):
-                trainer.step(batch)
-            jax.block_until_ready(trainer.state.params)
+                m = trainer.step(batch)
+            float(m["loss"])  # readback barrier
             dt = time.perf_counter() - t0
             toks = batch_size * seq_len * iters / dt
+            n = _n_params(trainer)
             return {
                 "tokens_per_sec": toks,
                 "batch_size": batch_size,
                 "seq_len": seq_len,
                 "step_ms": 1000 * dt / iters,
+                "mfu": toks * 6 * n / V5E_PEAK_FLOPS,
+                "n_params": n,
             }
         except Exception as e:  # OOM at this batch size -> halve
             last_err = e
-            if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" not in str(e):
+            msg = str(e)
+            if (
+                "RESOURCE_EXHAUSTED" not in msg
+                and "Out of memory" not in msg
+                and "remote_compile" not in msg  # AOT compiler OOM-kill
+            ):
                 raise
+            print(
+                f"batch {batch_size} failed ({msg.splitlines()[0][:100]}); halving",
+                file=sys.stderr,
+            )
     raise RuntimeError(f"all batch sizes OOM'd: {last_err}")
 
 
-def bench_decode(n_tokens: int = 64) -> float:
-    """p50 per-token latency (ms) of recurrent decode on the tiny config."""
+def bench_decode(config: str = "tiny", n_tokens: int = 64,
+                 prompt_len: int = 16, batch_size: int = 1) -> float:
+    """p50 per-token latency (ms) of recurrent decode."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from orion_tpu.generate import SampleConfig, generate
     from orion_tpu.models.configs import get_config
     from orion_tpu.models.transformer import TransformerLM
 
-    cfg = get_config("tiny")
+    cfg = get_config(config, max_seq_len=max(prompt_len + n_tokens + 8, 512))
     model = TransformerLM(cfg)
-    prompt = jnp.ones((1, 16), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), prompt)
+    prompt = jnp.ones((batch_size, prompt_len), jnp.int32)
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0), prompt)
+    params = jax.tree.map(
+        lambda s: jnp.full(s.shape, 0.01, s.dtype), params
+    )
     sample = SampleConfig(temperature=0.0)
-    generate(model, params, prompt, n_tokens, sample)  # compile
+    np.asarray(generate(model, params, prompt, n_tokens, sample))  # compile
     times = []
     for _ in range(5):
         t0 = time.perf_counter()
-        jax.block_until_ready(generate(model, params, prompt, n_tokens, sample))
+        np.asarray(generate(model, params, prompt, n_tokens, sample))
         times.append((time.perf_counter() - t0) / n_tokens * 1000)
     return sorted(times)[len(times) // 2]
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("bench")
+    ap.add_argument("--kernels", action="store_true",
+                    help="also run the Pallas-vs-XLA kernel micro-bench")
+    ap.add_argument("--quick", action="store_true",
+                    help="train bench only, fewer iters")
+    args = ap.parse_args(argv)
+
     _enable_compile_cache()
-    res = bench_train()
-    try:
-        decode_ms = bench_decode()
-        print(
-            json.dumps({"decode_p50_ms_per_token_tiny": round(decode_ms, 4)}),
-            file=sys.stderr,
-        )
-    except Exception as e:
-        print(f"decode bench failed: {e}", file=sys.stderr)
+    res = bench_train(iters=5 if args.quick else 10)
+
+    if not args.quick:
+        for name, kw in [
+            ("decode_p50_ms_per_token_tiny", dict(config="tiny")),
+            ("decode_p50_ms_per_token_lm1b3_b1_p512",
+             dict(config="lm_1b3", prompt_len=512, n_tokens=32)),
+            ("decode_p50_ms_per_token_lm1b3_b8_p512",
+             dict(config="lm_1b3", prompt_len=512, n_tokens=32, batch_size=8)),
+        ]:
+            try:
+                ms = bench_decode(**kw)
+                print(json.dumps({name: round(ms, 4)}), file=sys.stderr)
+            except Exception as e:
+                print(f"{name} failed: {e}", file=sys.stderr)
+
+    if args.kernels:
+        from orion_tpu.bench_kernels import run_all
+
+        for row in run_all():
+            print(json.dumps(row), file=sys.stderr)
 
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
@@ -143,13 +189,11 @@ def main() -> int:
                 "value": round(res["tokens_per_sec"], 1),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(vs, 4),
+                "mfu": round(res["mfu"], 4),
             }
         )
     )
-    print(
-        json.dumps({"detail": res}),
-        file=sys.stderr,
-    )
+    print(json.dumps({"detail": res}), file=sys.stderr)
     return 0
 
 

@@ -20,6 +20,16 @@ from orion_tpu.models.transformer import TransformerLM
 from orion_tpu.training.data import make_dataset
 
 
+def lm_eval_sums(model: TransformerLM, params, batch):
+    """batch [B, T+1] -> (sum of next-token xent, token count). The single
+    eval-loss definition — Trainer._eval_step delegates here too, so the
+    periodic in-training eval and this CLI can never drift apart."""
+    x, y = batch[:, :-1], batch[:, 1:]
+    logits = model.apply(params, x)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+    return losses.sum(), jnp.asarray(losses.size, jnp.float32)
+
+
 def evaluate_lm(
     model: TransformerLM,
     params,
@@ -30,10 +40,7 @@ def evaluate_lm(
 ) -> dict:
     @jax.jit
     def eval_step(params, batch):
-        x, y = batch[:, :-1], batch[:, 1:]
-        logits = model.apply(params, x)
-        losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
-        return losses.sum(), jnp.asarray(losses.size, jnp.float32)
+        return lm_eval_sums(model, params, batch)
 
     total, count = 0.0, 0.0
     for i in range(n_batches):
@@ -50,6 +57,9 @@ def evaluate_lm(
 
 
 def main(argv=None) -> int:
+    from orion_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
     p = argparse.ArgumentParser("orion_tpu.evaluate")
     p.add_argument("--config", default="tiny")
     p.add_argument("--ckpt-dir", required=True)

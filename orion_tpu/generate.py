@@ -157,6 +157,9 @@ def load_params(ckpt_dir: str, step: Optional[int] = None) -> Tuple[Any, int]:
 
 
 def main(argv=None) -> int:
+    from orion_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
     p = argparse.ArgumentParser("orion_tpu.generate")
     p.add_argument("--config", default="tiny")
     p.add_argument("--ckpt-dir", required=False, default=None)

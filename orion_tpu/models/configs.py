@@ -45,7 +45,7 @@ class ModelConfig:
     dtype: str = "bfloat16"  # activation/compute dtype
     param_dtype: str = "float32"
     backend: str = "auto"  # kernel dispatch for attention ops
-    chunk: int = 128  # linear-attn chunk size
+    chunk: Optional[int] = None  # linear-attn chunk size (None = tuned default)
     remat: bool = False  # per-block activation checkpointing
     remat_policy: str = "full"  # "full" | "dots" (save matmul outputs)
     # sequence/context parallelism: when True and the model is built with a

@@ -203,7 +203,7 @@ def linear_attention(
     v: Array,
     *,
     backend: str = "auto",
-    chunk: int = 128,
+    chunk: Optional[int] = None,
     eps: float = _DEFAULT_EPS,
     initial_state: Optional[Tuple[Array, Array]] = None,
     return_state: bool = False,
@@ -215,10 +215,16 @@ def linear_attention(
     normalizer, and both carried states — is one fused kernel pass
     (``linear_attention_pallas_fused``). On XLA, the numerator goes through
     ``causal_dot_product`` and the normalizer is a cumulative sum.
+    ``chunk=None`` picks the backend's tuned default (dispatch.resolve_chunk).
     """
-    from orion_tpu.ops.dispatch import causal_dot_product, resolve  # cycle-free
+    from orion_tpu.ops.dispatch import (  # cycle-free
+        causal_dot_product,
+        resolve,
+        resolve_chunk,
+    )
 
     b = resolve(backend)
+    chunk = resolve_chunk(chunk, q.shape[-2], b)
     if b in ("pallas", "pallas_interpret"):
         from orion_tpu.ops.pallas.causal_dot import linear_attention_pallas_fused
 

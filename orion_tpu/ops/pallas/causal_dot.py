@@ -17,14 +17,16 @@ Design (chunked kv-cumsum recurrence mapped onto the TPU):
 - all accumulation in fp32 regardless of input dtype (bf16 inputs hit the
   MXU natively with ``preferred_element_type=float32``).
 
-The backward pass is the same kernel re-used: with g the output cotangent,
-    dq = cdp(g, v, k) + g @ S0^T
-    dk = rev(cdp(rev(v), rev(g), rev(q))) + v @ dSf^T
-    dv = rev(cdp(rev(k), rev(q), rev(g))) + k @ dSf
-    dS0 = sum_t q_t (x) g_t + dSf
-(rev = flip along time). Wired up via jax.custom_vjp so the op is fully
-differentiable, including through the carried state — which is what makes
-sequence-parallel training (parallel/sequence.py) differentiable too.
+The backward is two kernel passes (no time-flip copies):
+    dq pass — the forward kernel on (g, v, k) with S0^T as carried state:
+        dq[t] = sum_{s<=t} (g_t·v_s) k_s + g_t @ S0^T
+    reverse pass (_bwd_rev_kernel) — grid walks chunks last->first with one
+    carried state R_t = dSf^T + sum_{s>=t} g_s (x) q_s, emitting both
+        dk[t] = v_t @ R_t   and   dv[t] = k_t @ R_t^T
+    and dS0 = (final R)^T for free.
+Wired up via jax.custom_vjp so the op is fully differentiable, including
+through the carried state — which is what makes sequence-parallel training
+(parallel/sequence.py) differentiable too.
 """
 
 from __future__ import annotations
@@ -114,6 +116,100 @@ def _cdp_flat(
     return out, sf
 
 
+def _bwd_rev_kernel(q_ref, k_ref, v_ref, g_ref, rinit_ref, dk_ref, dv_ref, rfin_ref, r_scr):
+    """Reverse-walking fused backward: one pass emits dk AND dv.
+
+        dk[t] = v_t @ R_t,   dv[t] = k_t @ R_t^T,
+        R_t   = dSf^T + sum_{s>=t} g_s (x) q_s   (Dv, Dk)
+
+    The grid's chunk axis is index-mapped last->first, so the carried VMEM
+    state R accumulates "later" chunks without materializing any time-flip
+    (the previous formulation spent 3 kernel passes + 6 jnp.flip HBM copies;
+    measured 0.64-0.79x vs XLA on-chip — this pass + the dq pass replace it).
+    dS0 = (final R)^T falls out for free.
+    """
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _():
+        r_scr[:] = rinit_ref[0].astype(jnp.float32)  # dSf^T
+
+    qi = q_ref[0]  # (C, Dk)
+    ki = k_ref[0]
+    vi = v_ref[0]
+    gi = g_ref[0]  # (C, Dv)
+
+    # within-chunk "s >= t" (anti-causal) contributions
+    svg = jax.lax.dot_general(
+        vi, gi, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (C, C): v_t · g_s
+    cdim = svg.shape[0]
+    row = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 1)
+    anti = row <= col  # s >= t
+    svg = jnp.where(anti, svg, 0.0)
+    skq = jax.lax.dot_general(
+        ki, qi, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (C, C): k_t · q_s
+    skq = jnp.where(anti, skq, 0.0)
+
+    dk_ref[0] = (
+        jnp.dot(svg, qi.astype(jnp.float32), preferred_element_type=jnp.float32)
+        + jnp.dot(vi.astype(jnp.float32), r_scr[:], preferred_element_type=jnp.float32)
+    ).astype(dk_ref.dtype)
+    dv_ref[0] = (
+        jnp.dot(skq, gi.astype(jnp.float32), preferred_element_type=jnp.float32)
+        + jax.lax.dot_general(
+            ki.astype(jnp.float32), r_scr[:],
+            dimension_numbers=(((1,), (1,)), ((), ())),  # k_t @ R^T
+            preferred_element_type=jnp.float32,
+        )
+    ).astype(dv_ref.dtype)
+
+    r_scr[:] = r_scr[:] + jax.lax.dot_general(
+        gi, qi, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # += sum_t g_t (x) q_t
+    rfin_ref[0] = r_scr[:]
+
+
+def _cdp_rev_flat(q, k, v, g, rinit, chunk, interpret):
+    """Fused (dk, dv, ds0) on flat [BH, T, D] inputs (T % chunk == 0).
+    ``rinit`` = dSf^T [BH, Dv, Dk] fp32; returns ds0 [BH, Dk, Dv] fp32."""
+    bh, t, dk = q.shape
+    dv = v.shape[-1]
+    nc = t // chunk
+    rev = lambda b, c: (b, nc - 1 - c, 0)  # noqa: E731
+
+    dk_out, dv_out, rfin = pl.pallas_call(
+        _bwd_rev_kernel,
+        grid=(bh, nc),
+        in_specs=[
+            pl.BlockSpec((1, chunk, dk), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dk), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dv), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dv), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dv, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, chunk, dk), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dv), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dv, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, dk), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, dv), jnp.float32),
+            jax.ShapeDtypeStruct((bh, dv, dk), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((dv, dk), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, rinit)
+    ds0 = jnp.swapaxes(rfin, -1, -2)
+    return dk_out, dv_out, ds0
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _cdp(q, k, v, s0, chunk, interpret):
     return _cdp_flat(q, k, v, s0, chunk, interpret)
@@ -128,28 +224,13 @@ def _cdp_bwd(chunk, interpret, res, cts):
     q, k, v, s0 = res
     g, dsf = cts
     g = g.astype(q.dtype)
-    dsf32 = dsf.astype(jnp.float32)
-    rev = lambda x: jnp.flip(x, axis=-2)  # noqa: E731
-    zkk = jnp.zeros((q.shape[0], v.shape[-1], q.shape[-1]), jnp.float32)  # for (g,v,k)
-    zvv = jnp.zeros((q.shape[0], v.shape[-1], q.shape[-1]), jnp.float32)
-    zqq = jnp.zeros((q.shape[0], q.shape[-1], v.shape[-1]), jnp.float32)
-
-    dq, _ = _cdp_flat(g, v, k, zkk, chunk, interpret)
-    dq = dq.astype(jnp.float32) + jnp.einsum(
-        "bte,bde->btd", g.astype(jnp.float32), s0.astype(jnp.float32)
-    )
-    dk, _ = _cdp_flat(rev(v), rev(g), rev(q), zvv, chunk, interpret)
-    dk = rev(dk).astype(jnp.float32) + jnp.einsum(
-        "bte,bde->btd", v.astype(jnp.float32), dsf32
-    )
-    dv, _ = _cdp_flat(rev(k), rev(q), rev(g), zqq, chunk, interpret)
-    dv = rev(dv).astype(jnp.float32) + jnp.einsum(
-        "btd,bde->bte", k.astype(jnp.float32), dsf32
-    )
-    ds0 = (
-        jnp.einsum("btd,bte->bde", q.astype(jnp.float32), g.astype(jnp.float32))
-        + dsf32
-    )
+    # dq pass: same forward kernel on (g, v, k), with S0^T as its carried-in
+    # state (out[t] = sum_{s<=t}(g_t.v_s) k_s + g_t @ S0^T)
+    s0t = jnp.swapaxes(s0.astype(jnp.float32), -1, -2)
+    dq, _ = _cdp_flat(g, v, k, s0t, chunk, interpret)
+    # dk + dv + ds0: one reverse-walking fused pass, dSf^T seeding the state
+    rinit = jnp.swapaxes(dsf.astype(jnp.float32), -1, -2)
+    dk, dv, ds0 = _cdp_rev_flat(q, k, v, g, rinit, chunk, interpret)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), ds0
 
 
@@ -161,7 +242,7 @@ def causal_dot_product_pallas(
     k: Array,
     v: Array,
     *,
-    chunk: int = 128,
+    chunk: Optional[int] = None,
     return_state: bool = False,
     initial_state: Optional[Array] = None,
     interpret: bool = False,
@@ -175,6 +256,7 @@ def causal_dot_product_pallas(
     batch_shape = q.shape[:-2]
     t, dk = q.shape[-2], q.shape[-1]
     dv = v.shape[-1]
+    chunk = _auto_chunk(chunk, t)
     bh = 1
     for s in batch_shape:
         bh *= s
@@ -314,37 +396,25 @@ def _fused_bwd_core(q, k, v, s0, z0, gnum, gden, gsf, gzf, chunk, interpret):
     (gden [BH,T,1] fp32), and final states (gsf, gzf)."""
     gsf32 = gsf.astype(jnp.float32)
 
-    # numerator part: the time-flip kernel identities (see module docstring)
-    rev = lambda x: jnp.flip(x, axis=-2)  # noqa: E731
-    zkk = jnp.zeros((q.shape[0], v.shape[-1], q.shape[-1]), jnp.float32)
-    zvv = jnp.zeros((q.shape[0], v.shape[-1], q.shape[-1]), jnp.float32)
-    zqq = jnp.zeros((q.shape[0], q.shape[-1], v.shape[-1]), jnp.float32)
-    dq, _ = _cdp_flat(gnum, v, k, zkk, chunk, interpret)
-    dq = dq.astype(jnp.float32) + jnp.einsum(
-        "bte,bde->btd", gnum.astype(jnp.float32), s0.astype(jnp.float32)
-    )
-    dk, _ = _cdp_flat(rev(v), rev(gnum), rev(q), zvv, chunk, interpret)
-    dk = rev(dk).astype(jnp.float32) + jnp.einsum(
-        "bte,bde->btd", v.astype(jnp.float32), gsf32
-    )
-    dv, _ = _cdp_flat(rev(k), rev(q), rev(gnum), zqq, chunk, interpret)
-    dv = rev(dv).astype(jnp.float32) + jnp.einsum(
-        "btd,bde->bte", k.astype(jnp.float32), gsf32
-    )
-    ds0 = (
-        jnp.einsum(
-            "btd,bte->bde", q.astype(jnp.float32), gnum.astype(jnp.float32)
-        )
-        + gsf32
-    )
+    # numerator part: dq via the forward kernel on (gnum, v, k) with S0^T
+    # folded into its carried state; dk/dv/ds0 via one reverse-walking
+    # fused pass (no time-flip copies — see _bwd_rev_kernel)
+    s0t = jnp.swapaxes(s0.astype(jnp.float32), -1, -2)
+    dq, _ = _cdp_flat(gnum, v, k, s0t, chunk, interpret)
+    dq = dq.astype(jnp.float32)
+    rinit = jnp.swapaxes(gsf32, -1, -2)
+    dk, dv, ds0 = _cdp_rev_flat(q, k, v, gnum, rinit, chunk, interpret)
 
     # denominator part: den[t] = q_t·z0 + Σ_{s<=t} q_t·k_s  (cheap XLA cumsums)
     kf = k.astype(jnp.float32)
     qf = q.astype(jnp.float32)
     zcum = jnp.cumsum(kf, axis=-2) + z0.astype(jnp.float32)  # (BH,1,Dk) bcast
     gq_den = gden * zcum
-    gk_den = rev(jnp.cumsum(rev(gden * qf), axis=-2))
-    gz0 = jnp.sum(gden * qf, axis=-2, keepdims=True)  # (BH, 1, Dk)
+    # suffix-inclusive cumsum without flips: Σ_{s>=t} x = total - Σ_{s<t} x
+    gqd = gden * qf
+    cs = jnp.cumsum(gqd, axis=-2)
+    gk_den = cs[..., -1:, :] - cs + gqd
+    gz0 = cs[..., -1:, :]  # Σ_t gden_t q_t  (BH, 1, Dk)
 
     # final-z cotangent: zf = z0 + Σ_s k_s
     gzf32 = gzf.astype(jnp.float32)
@@ -401,12 +471,21 @@ def _lin_attn_fused_raw_bwd(chunk, interpret, res, cts):
 _lin_attn_fused_raw.defvjp(_lin_attn_fused_raw_fwd, _lin_attn_fused_raw_bwd)
 
 
+def _auto_chunk(chunk: Optional[int], t: int) -> int:
+    from orion_tpu.ops.dispatch import resolve_chunk
+
+    return resolve_chunk(chunk, t, "pallas")
+
+
 def _prep_fused(q, k, v, chunk, initial_state):
     """Shared flatten + tail-pad + state-init for the fused entry points.
-    Returns (qf, kf, vf, s0, z0, batch_shape, t)."""
+    Returns (qf, kf, vf, s0, z0, batch_shape, t, chunk) with chunk resolved
+    to the tuned default when None."""
+    chunk = _auto_chunk(chunk, q.shape[-2])
     batch_shape = q.shape[:-2]
     t, dk = q.shape[-2], q.shape[-1]
     dv = v.shape[-1]
+    chunk = _auto_chunk(chunk, t)
     bh = 1
     for s in batch_shape:
         bh *= s
@@ -425,7 +504,7 @@ def _prep_fused(q, k, v, chunk, initial_state):
     else:
         s0 = initial_state[0].astype(jnp.float32).reshape(bh, dk, dv)
         z0 = initial_state[1].astype(jnp.float32).reshape(bh, 1, dk)
-    return qf, kf, vf, s0, z0, batch_shape, t
+    return qf, kf, vf, s0, z0, batch_shape, t, chunk
 
 
 def linear_attention_pallas_fused(
@@ -433,7 +512,7 @@ def linear_attention_pallas_fused(
     k: Array,
     v: Array,
     *,
-    chunk: int = 128,
+    chunk: Optional[int] = None,
     eps: float = 1e-6,
     initial_state: Optional[Tuple[Array, Array]] = None,
     return_state: bool = False,
@@ -452,7 +531,7 @@ def linear_attention_pallas_fused(
     returning the final (S, z) — the prefill→decode handoff. Differentiable
     through everything including the states (custom VJP: kernel passes for
     the numerator, O(T·Dk) cumsums for the denominator)."""
-    qf, kf, vf, s0, z0, batch_shape, t = _prep_fused(q, k, v, chunk, initial_state)
+    qf, kf, vf, s0, z0, batch_shape, t, chunk = _prep_fused(q, k, v, chunk, initial_state)
     dk, dv = q.shape[-1], v.shape[-1]
 
     out, sf, zf, den = _lin_attn_fused(qf, kf, vf, s0, z0, chunk, eps, interpret)
@@ -472,7 +551,7 @@ def linear_attention_pallas_parts(
     k: Array,
     v: Array,
     *,
-    chunk: int = 128,
+    chunk: Optional[int] = None,
     initial_state: Optional[Tuple[Array, Array]] = None,
     interpret: bool = False,
 ):
@@ -484,7 +563,7 @@ def linear_attention_pallas_parts(
     inheriting bf16 rounding from the locally-normalized output.
     Differentiable via custom VJP (same kernel identities, no quotient
     rule needed)."""
-    qf, kf, vf, s0, z0, batch_shape, t = _prep_fused(q, k, v, chunk, initial_state)
+    qf, kf, vf, s0, z0, batch_shape, t, chunk = _prep_fused(q, k, v, chunk, initial_state)
     dk, dv = q.shape[-1], v.shape[-1]
 
     num, den, sf, zf = _lin_attn_fused_raw(qf, kf, vf, s0, z0, chunk, interpret)

@@ -92,8 +92,8 @@ def softmax_attention(
     mask: Optional[Array] = None,
     scale: Optional[float] = None,
     backend: str = "auto",
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
 ) -> Array:
     """Dispatching softmax attention: Pallas flash on TPU, XLA elsewhere.
 

@@ -41,10 +41,27 @@ def reduce_scatter(x: Array, axis: Axis, *, scatter_axis: int = 0) -> Array:
 
 
 def ppermute_shift(x: Array, axis: str, shift: int = 1) -> Array:
-    """Rotate shards around the ring: device i -> device (i+shift) % n."""
+    """Rotate shards around the ring: device i -> device (i+shift) % n.
+    The neighbor-to-neighbor hop ring attention runs on (ring.py)."""
     n = lax.axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
+
+
+def exclusive_prefix_sum(x_local: Array, axis: Axis) -> Array:
+    """Σ over shards j < my_index of per-shard partials — the cross-shard
+    combine for sequence-parallel linear attention (sequence.py): each
+    shard's kv-cumsum state is corrected by the sum of every earlier
+    shard's. all_gather the tiny per-shard tensors, then a masked sum
+    (axis sizes are small; O(sp) memory is nothing)."""
+    import jax.numpy as jnp
+
+    gathered = lax.all_gather(x_local, axis)  # [sp, ...]
+    n = gathered.shape[0]
+    idx = lax.axis_index(axis)
+    mask = (jnp.arange(n) < idx).astype(gathered.dtype)
+    mask = mask.reshape((n,) + (1,) * (gathered.ndim - 1))
+    return jnp.sum(gathered * mask, axis=0)
 
 
 def all_to_all(x: Array, axis: str, *, split_axis: int, concat_axis: int) -> Array:
@@ -66,6 +83,7 @@ __all__ = [
     "all_gather",
     "reduce_scatter",
     "ppermute_shift",
+    "exclusive_prefix_sum",
     "all_to_all",
     "axis_index",
     "axis_size",

@@ -27,6 +27,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from orion_tpu.parallel.collectives import ppermute_shift
+
 Array = jax.Array
 
 _NEG = -1e30
@@ -104,9 +106,8 @@ def ring_attention_local(
             m, l, acc = attend((m, l, acc))
 
         # rotate kv to the next device; after n-1 steps every block visited
-        perm = [(d, (d + 1) % n) for d in range(n)]
-        k_nxt = lax.ppermute(k_blk, axis, perm)
-        v_nxt = lax.ppermute(v_blk, axis, perm)
+        k_nxt = ppermute_shift(k_blk, axis)
+        v_nxt = ppermute_shift(v_blk, axis)
         return k_nxt, v_nxt, m, l, acc
 
     _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))

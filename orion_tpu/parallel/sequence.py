@@ -42,15 +42,7 @@ def _local_states(k: Array, v: Array) -> Tuple[Array, Array]:
     return s, z
 
 
-def _exclusive_prefix(x_local: Array, axis: str) -> Array:
-    """Σ over shards j < my_index of per-shard reductions. all_gather the
-    tiny tensors, then a masked sum (sp is small; O(sp) memory is nothing)."""
-    gathered = lax.all_gather(x_local, axis)  # [sp, ...]
-    n = gathered.shape[0]
-    idx = lax.axis_index(axis)
-    mask = (jnp.arange(n) < idx).astype(gathered.dtype)
-    mask = mask.reshape((n,) + (1,) * (gathered.ndim - 1))
-    return jnp.sum(gathered * mask, axis=0)
+from orion_tpu.parallel.collectives import exclusive_prefix_sum as _exclusive_prefix
 
 
 def sp_linear_attention_local(
@@ -60,7 +52,7 @@ def sp_linear_attention_local(
     axis: str = "sp",
     *,
     backend: str = "auto",
-    chunk: int = 128,
+    chunk: Optional[int] = None,
     eps: float = 1e-6,
 ) -> Array:
     """The shard_map body: q,k,v are the LOCAL [.., T/sp, D] shards (post
@@ -112,7 +104,7 @@ def sp_linear_attention(
     *,
     axis: str = "sp",
     backend: str = "auto",
-    chunk: int = 128,
+    chunk: Optional[int] = None,
 ) -> Array:
     """Global entry: q,k,v [B, H, T, D] with T sharded over ``axis``.
     Batch rides on (dp, fsdp); heads on tp."""

@@ -107,6 +107,9 @@ def build_argparser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
+    from orion_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
     if args.distributed:
         initialize_distributed()
     from orion_tpu.utils.config import apply_overrides, load_json_overrides

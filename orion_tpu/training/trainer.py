@@ -135,6 +135,15 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
             sched, b1=cfg.b1, b2=cfg.b2,
             weight_decay=cfg.weight_decay, mask=_wd_mask, mu_dtype=mu_dtype,
         )
+    elif cfg.optimizer == "adafactor":
+        # factored second moment (O(n+m) state per matrix): the single-chip
+        # memory-headroom option for 1.3B+ (SURVEY §7 "bigger-batch").
+        # No decoupled weight decay — standard adafactor usage; its
+        # update-clipping plays the stabilizing role.
+        opt = optax.adafactor(
+            sched, min_dim_size_to_factor=128,
+            multiply_by_parameter_scale=False,
+        )
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     chain = [opt]
@@ -166,9 +175,10 @@ class Trainer:
             )
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
-        self.model = TransformerLM(
-            cfg.model, mesh=self.mesh if cfg.model.sequence_parallel else None
-        )
+        # mesh is always passed: the model uses it for activation sharding
+        # constraints; the sp attention path additionally gates on
+        # cfg.sequence_parallel and mesh sp-axis size > 1
+        self.model = TransformerLM(cfg.model, mesh=self.mesh)
         self.tx = make_optimizer(cfg)
         self.sched = make_schedule(cfg)
         self.batch_shd = batch_sharding(self.mesh)
@@ -274,10 +284,9 @@ class Trainer:
         return new_state, metrics
 
     def _eval_step(self, params, batch: Array) -> Tuple[Array, Array]:
-        x, y = batch[:, :-1], batch[:, 1:]
-        logits = self.model.apply(params, x)
-        losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
-        return losses.sum(), jnp.asarray(losses.size, jnp.float32)
+        from orion_tpu.evaluate import lm_eval_sums  # single eval-loss defn
+
+        return lm_eval_sums(self.model, params, batch)
 
     # -- host API -----------------------------------------------------------
 

@@ -1,0 +1,27 @@
+"""Persistent XLA compilation cache (shared by the CLIs and bench.py).
+
+The 1.3B train step takes minutes to AOT-compile through the TPU tunnel;
+caching it on disk makes every later invocation start in seconds.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "ORION_TPU_CACHE",
+            os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache"),
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
+
+
+__all__ = ["enable_compile_cache"]
