@@ -112,18 +112,44 @@ def generate(
     max_new_tokens: int,
     sample: Optional[SampleConfig] = None,
     rng: Optional[Array] = None,
+    mesh: Optional[Any] = None,
 ) -> Array:
-    """Batched generation; one compile per (prompt_len, max_new_tokens)."""
+    """Batched generation; one compile per (prompt_len, max_new_tokens).
+
+    ``mesh``: decode over a device mesh (SURVEY.md P1–P4 applied to
+    inference). Params are placed by the training sharding rules (fsdp
+    feature sharding + Megatron tp head sharding), the prompt batch is
+    sharded over (dp, fsdp), and GSPMD propagates those layouts through
+    prefill and the decode scan — KV/ring caches come out batch- and
+    head-sharded with no model changes. A batch that doesn't divide
+    dp*fsdp is placed replicated instead (tp sharding still applies).
+    """
     if prompt.ndim == 1:
         prompt = prompt[None]
     cap = model.cfg.max_seq_len
     assert prompt.shape[1] + max_new_tokens <= cap, (
         f"prompt {prompt.shape[1]} + new {max_new_tokens} exceeds max_seq_len {cap}"
     )
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if mesh is not None:
+        from orion_tpu.parallel.sharding import (
+            batch_sharding,
+            replicated,
+            shard_params,
+        )
+
+        n_data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        params = shard_params(params, mesh)
+        spec = (
+            batch_sharding(mesh)
+            if prompt.shape[0] % n_data == 0
+            else replicated(mesh)
+        )
+        prompt = jax.device_put(prompt, spec)
     return _generate_jit(
         model,
         params,
-        jnp.asarray(prompt, jnp.int32),
+        prompt,
         int(max_new_tokens),
         sample or SampleConfig(),
         rng if rng is not None else jax.random.PRNGKey(0),
@@ -169,6 +195,11 @@ def main(argv=None) -> int:
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--mesh",
+        default=None,
+        help="decode over a device mesh, e.g. 'dp=4,tp=2' (batch over dp/fsdp, heads over tp)",
+    )
     args = p.parse_args(argv)
 
     from orion_tpu.utils.tokenizer import ByteTokenizer
@@ -185,6 +216,25 @@ def main(argv=None) -> int:
         params = model.init(jax.random.PRNGKey(0), prompt)
         print("no --ckpt-dir: random params (smoke test)", file=sys.stderr)
 
+    mesh = None
+    if args.mesh:
+        from orion_tpu.parallel.mesh import AXES, MeshConfig, make_mesh
+
+        kw = {}
+        for item in args.mesh.split(","):
+            if not item:
+                continue
+            name, sep, val = item.partition("=")
+            if not sep or name not in AXES or not val.lstrip("-").isdigit():
+                p.error(
+                    f"--mesh: bad entry {item!r}; expected axis=N with axis "
+                    f"in {AXES}, e.g. 'dp=4,tp=2'"
+                )
+            kw[name] = int(val)
+        kw.setdefault("dp", 1)  # don't let dp=-1 absorb devices unasked
+        mesh = make_mesh(MeshConfig(**kw))
+        print(f"mesh: {dict(mesh.shape)}", file=sys.stderr)
+
     out = generate(
         model,
         params,
@@ -192,6 +242,7 @@ def main(argv=None) -> int:
         args.max_new_tokens,
         SampleConfig(args.temperature, args.top_k, args.top_p),
         jax.random.PRNGKey(args.seed),
+        mesh=mesh,
     )
     print(args.prompt + tok.decode([int(t) for t in out[0]]))
     return 0

@@ -151,3 +151,37 @@ def test_profiling_step_timer():
         t.mark()
     s = t.summary()
     assert s["steps"] == 4 and s["p50_ms"] >= 0 and "tokens_per_sec" in s
+
+
+def test_sharded_generate_parity():
+    """Mesh-sharded decode (VERDICT r1 item 7): dp=4 batch sharding and
+    dp=2/tp=2 head sharding must reproduce single-device greedy decode
+    token-for-token. Params go through the training sharding rules; GSPMD
+    propagates the layouts through prefill + the decode scan."""
+    from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    model, params = _model_and_params()
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (4, 7), 0, CFG.vocab_size)
+    ref = generate(model, params, prompt, 9, SampleConfig(temperature=0.0))
+
+    for mc in (MeshConfig(dp=4), MeshConfig(dp=2, fsdp=1, tp=2)):
+        mesh = make_mesh(mc)
+        out = generate(
+            model, params, prompt, 9, SampleConfig(temperature=0.0), mesh=mesh
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref), err_msg=str(mc))
+
+
+def test_sharded_generate_sampled_parity():
+    """Same-rng sampled decode over a mesh matches single-device (threefry
+    is partitionable, so the per-step categorical draws are identical)."""
+    from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    model, params = _model_and_params()
+    prompt = jnp.ones((4, 5), jnp.int32)
+    cfg = SampleConfig(temperature=0.8, top_k=8)
+    rng = jax.random.PRNGKey(11)
+    ref = generate(model, params, prompt, 6, cfg, rng=rng)
+    mesh = make_mesh(MeshConfig(dp=4))
+    out = generate(model, params, prompt, 6, cfg, rng=rng, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
