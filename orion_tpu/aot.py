@@ -1,0 +1,177 @@
+"""`python -m orion_tpu.aot` — ahead-of-time lowering + memory planning for
+a sharded train step (SURVEY.md M4 buildability / VERDICT r1 item 8).
+
+Answers "does this config build, shard, and fit?" without touching real
+weights or real hardware: the full GSPMD train step is lowered and compiled
+against *abstract* state (jax.ShapeDtypeStructs carrying NamedShardings),
+so a 7B step can be validated on a laptop-sized host with a virtual
+8-device mesh (``--force-cpu-devices N``). Reports:
+
+- per-device parameter / optimizer-state bytes (from the sharding rules)
+- the compiler's own memory analysis (argument/output/temp/code bytes)
+  when the backend exposes it
+- the collectives GSPMD inserted (all-gather / reduce-scatter / all-reduce
+  counts in the optimized HLO) — evidence the sharding rules actually
+  engaged rather than silently replicating
+
+The reference validates its big configs by launching them (BASELINE.json
+config #5 "7B hybrid"; reference checkout never mounted — SURVEY.md §0);
+XLA's AOT path lets us make the same claim statically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import dataclasses
+import json
+import re
+import sys
+from typing import Any, Dict, Optional
+
+
+def _bytes_per_device(abstract: Any, shardings: Any) -> int:
+    """Sum of leaf bytes / shard-factor over the state tree."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf, shd in zip(jax.tree.leaves(abstract), jax.tree.leaves(shardings)):
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        factor = 1
+        for dim, ax in enumerate(shd.spec):
+            if ax is None or dim >= len(leaf.shape):
+                continue
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            for a in axes:
+                factor *= shd.mesh.shape[a]
+        total += n * leaf.dtype.itemsize // max(factor, 1)
+    return total
+
+
+def _collective_counts(hlo_text: str) -> Dict[str, int]:
+    ops = ("all-gather", "reduce-scatter", "all-reduce", "all-to-all",
+           "collective-permute")
+    counts: Dict[str, int] = collections.Counter()
+    for op in ops:
+        counts[op] = len(re.findall(rf"\b{op}(?:-start)?\(", hlo_text))
+    return dict(counts)
+
+
+def plan(
+    cfg,
+    compile_step: bool = True,
+    hlo: bool = False,
+) -> Dict[str, Any]:
+    """Lower (and optionally compile) the sharded train step for
+    ``cfg: TrainConfig``; return the planning report dict."""
+    import jax
+    import numpy as np
+
+    from orion_tpu.training.trainer import Trainer
+
+    trainer = Trainer(cfg, materialize=False)
+    abstract = trainer.abstract_state()
+    batch = jax.ShapeDtypeStruct(
+        (cfg.batch_size, cfg.seq_len + 1), np.int32, sharding=trainer.batch_shd
+    )
+
+    n_params = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree.leaves(trainer._abstract.params)
+    )
+    report: Dict[str, Any] = {
+        "config": cfg.model.name,
+        "mesh": dict(trainer.mesh.shape),
+        "batch_size": cfg.batch_size,
+        "seq_len": cfg.seq_len,
+        "n_params": n_params,
+        "param_bytes_per_device": _bytes_per_device(
+            trainer._abstract.params,
+            trainer.state_shardings.params,
+        ),
+        "state_bytes_per_device": _bytes_per_device(
+            trainer._abstract, trainer.state_shardings
+        ),
+    }
+
+    lowered = trainer._step_fn.lower(abstract, batch)
+    report["lowered"] = True
+    if not compile_step:
+        return report
+
+    compiled = lowered.compile()
+    report["compiled"] = True
+    # these introspection APIs are backend-dependent; record failures rather
+    # than silently dropping the sections the tool exists to report
+    try:
+        hlo_text = compiled.as_text()
+        report["collectives"] = _collective_counts(hlo_text)
+        if hlo:
+            report["hlo_text"] = hlo_text
+    except Exception as e:
+        report["collectives_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            for k in (
+                "argument_size_in_bytes",
+                "output_size_in_bytes",
+                "temp_size_in_bytes",
+                "generated_code_size_in_bytes",
+            ):
+                v = getattr(ma, k, None)
+                if v is not None:
+                    report[k] = int(v)
+    except Exception as e:
+        report["memory_analysis_error"] = f"{type(e).__name__}: {e}"[:200]
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("orion_tpu.aot")
+    p.add_argument("--config", default="hybrid_7b")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="default: model max_seq_len")
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--optimizer", default="adamw")
+    p.add_argument("--lower-only", action="store_true",
+                   help="skip XLA compilation (faster; no memory analysis)")
+    p.add_argument("--force-cpu-devices", type=int, default=0,
+                   help="plan on N virtual CPU devices instead of real chips")
+    args = p.parse_args(argv)
+
+    if args.force_cpu_devices:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update(
+            "jax_num_cpu_devices", args.force_cpu_devices
+        )
+
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.parallel.mesh import MeshConfig
+    from orion_tpu.training.trainer import TrainConfig
+
+    model = get_config(args.config)
+    seq_len = args.seq_len or model.max_seq_len
+    if seq_len > model.max_seq_len:
+        model = dataclasses.replace(model, max_seq_len=seq_len)
+    cfg = TrainConfig(
+        model=model,
+        batch_size=args.batch_size,
+        seq_len=seq_len,
+        optimizer=args.optimizer,
+        mesh=MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp),
+    )
+    report = plan(cfg, compile_step=not args.lower_only)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

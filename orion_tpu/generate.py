@@ -195,12 +195,15 @@ def main(argv=None) -> int:
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument(
-        "--mesh",
-        default=None,
-        help="decode over a device mesh, e.g. 'dp=4,tp=2' (batch over dp/fsdp, heads over tp)",
-    )
+    # same mesh flags as train.py / aot.py; any axis > 1 builds a mesh
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
     args = p.parse_args(argv)
+    for ax in ("dp", "fsdp", "tp", "sp"):
+        if getattr(args, ax) < 1:
+            p.error(f"--{ax} must be >= 1")
 
     from orion_tpu.utils.tokenizer import ByteTokenizer
 
@@ -217,22 +220,12 @@ def main(argv=None) -> int:
         print("no --ckpt-dir: random params (smoke test)", file=sys.stderr)
 
     mesh = None
-    if args.mesh:
-        from orion_tpu.parallel.mesh import AXES, MeshConfig, make_mesh
+    if args.dp * args.fsdp * args.tp * args.sp > 1:
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
 
-        kw = {}
-        for item in args.mesh.split(","):
-            if not item:
-                continue
-            name, sep, val = item.partition("=")
-            if not sep or name not in AXES or not val.lstrip("-").isdigit():
-                p.error(
-                    f"--mesh: bad entry {item!r}; expected axis=N with axis "
-                    f"in {AXES}, e.g. 'dp=4,tp=2'"
-                )
-            kw[name] = int(val)
-        kw.setdefault("dp", 1)  # don't let dp=-1 absorb devices unasked
-        mesh = make_mesh(MeshConfig(**kw))
+        mesh = make_mesh(
+            MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp)
+        )
         print(f"mesh: {dict(mesh.shape)}", file=sys.stderr)
 
     out = generate(

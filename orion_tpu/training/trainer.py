@@ -164,7 +164,16 @@ def lm_loss(model: TransformerLM, params, batch: Array, dropout_rng=None):
 
 
 class Trainer:
-    def __init__(self, cfg: TrainConfig, mesh: Optional[Mesh] = None):
+    def __init__(
+        self,
+        cfg: TrainConfig,
+        mesh: Optional[Mesh] = None,
+        materialize: bool = True,
+    ):
+        """``materialize=False`` builds the mesh, shardings, and jitted step
+        WITHOUT allocating params/optimizer state — the AOT planning path
+        (orion_tpu/aot.py): a 7B step can be lowered and compiled on a
+        virtual CPU mesh whose host could never hold the weights."""
         # fail loudly: out-of-range positions would be silently clamped by
         # XLA gather, yielding wrong position embeddings (train.py's CLI
         # auto-bumps max_seq_len; the library path must not rely on that)
@@ -199,12 +208,14 @@ class Trainer:
                 nonfinite=jnp.zeros((), jnp.int32),
             )
 
-        abstract = jax.eval_shape(init_fn, self._init_rng)
+        self._abstract = jax.eval_shape(init_fn, self._init_rng)
         # one rule set shards the whole state: optimizer-moment paths end in
         # the same 'wq/kernel'-style suffixes the param rules match on
-        self.state_shardings = param_shardings(abstract, self.mesh)
-        self.state = jax.jit(init_fn, out_shardings=self.state_shardings)(
-            self._init_rng
+        self.state_shardings = param_shardings(self._abstract, self.mesh)
+        self.state = (
+            jax.jit(init_fn, out_shardings=self.state_shardings)(self._init_rng)
+            if materialize
+            else None
         )
 
         self._step_fn = jax.jit(
@@ -291,6 +302,10 @@ class Trainer:
     # -- host API -----------------------------------------------------------
 
     def step(self, batch: Array) -> Dict[str, float]:
+        assert self.state is not None, (
+            "Trainer was built with materialize=False (AOT planning only); "
+            "no state to train"
+        )
         self.state, metrics = self._step_fn(self.state, batch)
         return metrics
 
@@ -340,6 +355,9 @@ class Trainer:
         return last
 
     def evaluate(self, data_iter, n_batches: Optional[int] = None) -> Dict[str, float]:
+        assert self.state is not None, (
+            "Trainer was built with materialize=False (AOT planning only)"
+        )
         n = n_batches or self.cfg.eval_batches
         total, count = 0.0, 0.0
         for _ in range(n):
@@ -356,7 +374,7 @@ class Trainer:
         def leaf(s, shd):
             return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=shd)
 
-        return jax.tree.map(leaf, self.state, self.state_shardings)
+        return jax.tree.map(leaf, self._abstract, self.state_shardings)
 
     def restore(self, ckpt, step: Optional[int] = None):
         self.state = ckpt.restore(self.abstract_state(), step)

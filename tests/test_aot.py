@@ -1,0 +1,62 @@
+"""AOT planning tests (SURVEY.md M4 buildability / VERDICT r1 item 8): the
+7B hybrid config must lower with the sharding rules applied, and a scaled
+hybrid must compile end-to-end with GSPMD collectives in the optimized HLO.
+"""
+
+import dataclasses
+
+from orion_tpu.aot import plan
+from orion_tpu.models.configs import get_config, hybrid_pattern, ModelConfig
+from orion_tpu.parallel.mesh import MeshConfig
+from orion_tpu.training.trainer import TrainConfig
+
+
+def test_hybrid_7b_lowers_sharded():
+    """The flagship stretch config: full train step lowers against abstract
+    fsdp4/tp2-sharded state; per-device state fits a 16GB chip."""
+    model = get_config("hybrid_7b")
+    cfg = TrainConfig(
+        model=model,
+        batch_size=16,
+        seq_len=model.max_seq_len,
+        mesh=MeshConfig(dp=1, fsdp=4, tp=2),
+    )
+    rep = plan(cfg, compile_step=False)
+    assert rep["lowered"]
+    assert 6.0e9 < rep["n_params"] < 7.5e9, rep["n_params"]
+    # adamw fp32: params + 2 moments + grads transient; the sharded resident
+    # state must fit a 16GB device
+    assert rep["state_bytes_per_device"] < 16e9, rep
+    # fsdp/tp actually shard ~everything: per-device param bytes well under
+    # half the replicated 26.5GB
+    assert rep["param_bytes_per_device"] < 4e9, rep
+
+
+def test_scaled_hybrid_compiles_with_collectives():
+    """A 1/16-width 7B (same layer pattern, same sharding rules) compiles
+    through GSPMD on the virtual mesh and the optimized HLO contains the
+    fsdp/tp collectives — proof the rules engaged rather than replicating."""
+    model = ModelConfig(
+        name="hybrid_scaled",
+        vocab_size=512,
+        d_model=256,
+        n_layers=8,
+        n_heads=8,
+        layer_types=hybrid_pattern(8, period=4),
+        window=64,
+        max_seq_len=256,
+        dtype="float32",
+        backend="xla",
+        remat=True,
+    )
+    cfg = TrainConfig(
+        model=model,
+        batch_size=4,
+        seq_len=128,
+        mesh=MeshConfig(dp=1, fsdp=2, tp=2),
+    )
+    rep = plan(cfg, compile_step=True)
+    assert rep["compiled"]
+    cc = rep["collectives"]
+    assert cc["all-gather"] > 0, cc  # fsdp param gathers
+    assert cc["all-reduce"] > 0, cc  # tp psums / grad reductions
