@@ -73,8 +73,23 @@ def main(argv=None) -> int:
     from orion_tpu.generate import load_params
 
     cfg = get_config(args.config)
-    model = TransformerLM(cfg)
     params, step = load_params(args.ckpt_dir, args.step)
+    # the architecture must match the checkpoint, not the named config:
+    # train.py auto-bumps max_seq_len when seq_len >= max_seq_len, so read
+    # the real positional capacity off the stored pos_embed table
+    try:
+        pos_rows = params["params"]["pos_embed"]["embedding"].shape[0]
+        if pos_rows != cfg.max_seq_len:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, max_seq_len=pos_rows)
+    except (KeyError, TypeError):
+        pass
+    assert args.seq_len < cfg.max_seq_len, (
+        f"--seq-len {args.seq_len} needs positions up to {args.seq_len}, but "
+        f"the checkpoint was trained with max_seq_len={cfg.max_seq_len}"
+    )
+    model = TransformerLM(cfg)
     dataset = make_dataset(args.data, args.seq_len, cfg.vocab_size)
     res = evaluate_lm(model, params, dataset, args.batch_size, args.n_batches)
     res["step"] = step
