@@ -113,7 +113,9 @@ def causal_dot_product_chunked(
 
     mask = jnp.tril(jnp.ones((chunk, chunk), dtype=jnp.float32))
     if initial_state is None:
-        s0 = jnp.zeros((*batch_shape, dk, dv), dtype=jnp.float32)
+        from orion_tpu.ops.pallas.causal_dot import vma_zeros_state
+
+        s0 = vma_zeros_state(kf, vf)
     else:
         s0 = initial_state.astype(jnp.float32)
 

@@ -42,6 +42,32 @@ from jax.experimental.pallas import tpu as pltpu
 Array = jax.Array
 
 
+def vma_zeros_state(kf: Array, vf: Array) -> Array:
+    """[.., Dk, Dv] zeros *derived from k/v* (0 * k1^T v1) so the result
+    inherits their varying-mesh-axes type: a plain jnp.zeros initial state
+    trips shard_map(check_vma=True) bodies (carry/input unvarying while the
+    data is varying). XLA folds the zero-multiply. One helper so the
+    workaround has a single place to die when jnp.zeros grows a vma arg."""
+    return 0.0 * jnp.einsum(
+        "...td,...te->...de",
+        kf[..., :1, :].astype(jnp.float32),
+        vf[..., :1, :].astype(jnp.float32),
+    )
+
+
+def _sds(shape, dtype, like: Array):
+    """ShapeDtypeStruct for a pallas_call output, inheriting ``like``'s
+    varying-mesh-axes type so the kernels compose with
+    shard_map(check_vma=True) bodies (sequence/pipeline parallel)."""
+    try:
+        vma = jax.api_util.shaped_abstractify(like).vma
+    except Exception:
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _kernel(q_ref, k_ref, v_ref, s0_ref, out_ref, sf_ref, s_scr):
     c = pl.program_id(1)
 
@@ -102,8 +128,8 @@ def _cdp_flat(
             pl.BlockSpec((1, dk, dv), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, dv), q.dtype),
-            jax.ShapeDtypeStruct((bh, dk, dv), jnp.float32),
+            _sds((bh, t, dv), q.dtype, q),
+            _sds((bh, dk, dv), jnp.float32, q),
         ],
         scratch_shapes=[pltpu.VMEM((dk, dv), jnp.float32)],
         cost_estimate=pl.CostEstimate(
@@ -199,9 +225,9 @@ def _cdp_rev_flat(q, k, v, g, rinit, chunk, interpret):
             pl.BlockSpec((1, dv, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, dk), jnp.float32),
-            jax.ShapeDtypeStruct((bh, t, dv), jnp.float32),
-            jax.ShapeDtypeStruct((bh, dv, dk), jnp.float32),
+            _sds((bh, t, dk), jnp.float32, q),
+            _sds((bh, t, dv), jnp.float32, q),
+            _sds((bh, dv, dk), jnp.float32, q),
         ],
         scratch_shapes=[pltpu.VMEM((dv, dk), jnp.float32)],
         interpret=interpret,
@@ -270,7 +296,7 @@ def causal_dot_product_pallas(
         qf, kf, vf = jnp.pad(qf, pad), jnp.pad(kf, pad), jnp.pad(vf, pad)
 
     if initial_state is None:
-        s0 = jnp.zeros((bh, dk, dv), jnp.float32)
+        s0 = vma_zeros_state(kf, vf)
     else:
         s0 = initial_state.astype(jnp.float32).reshape(bh, dk, dv)
 
@@ -363,10 +389,10 @@ def _cdpn_flat(q, k, v, s0, z0, chunk, interpret):
             pl.BlockSpec((1, 1, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, dv), jnp.float32),
-            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
-            jax.ShapeDtypeStruct((bh, dk, dv), jnp.float32),
-            jax.ShapeDtypeStruct((bh, 1, dk), jnp.float32),
+            _sds((bh, t, dv), jnp.float32, q),
+            _sds((bh, t, 1), jnp.float32, q),
+            _sds((bh, dk, dv), jnp.float32, q),
+            _sds((bh, 1, dk), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((dk, dv), jnp.float32),
@@ -499,8 +525,8 @@ def _prep_fused(q, k, v, chunk, initial_state):
         qf, kf, vf = jnp.pad(qf, pad), jnp.pad(kf, pad), jnp.pad(vf, pad)
 
     if initial_state is None:
-        s0 = jnp.zeros((bh, dk, dv), jnp.float32)
-        z0 = jnp.zeros((bh, 1, dk), jnp.float32)
+        s0 = vma_zeros_state(kf, vf)
+        z0 = 0.0 * kf[:, :1].astype(jnp.float32)
     else:
         s0 = initial_state[0].astype(jnp.float32).reshape(bh, dk, dv)
         z0 = initial_state[1].astype(jnp.float32).reshape(bh, 1, dk)

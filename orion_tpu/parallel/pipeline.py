@@ -1,0 +1,151 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pp`` mesh
+axis (SURVEY.md round-2 carry-over; BASELINE.json north_star "run end-to-end
+on a TPU pod" — the reference scales depth across nodes with NCCL
+point-to-point sends; reference checkout never mounted, SURVEY.md §0).
+
+TPU-native formulation: no send/recv rank loops — ONE SPMD program over the
+mesh where each pp device holds a *stack* of its stage's blocks (params
+stacked on a leading axis, sharded over pp), and activations hop stage→stage
+with ``lax.ppermute`` (neighbor ICI hops), exactly like ring attention but
+along depth instead of sequence.
+
+Schedule (GPipe, forward):
+
+    step s ∈ [0, n_micro + pp - 1):  stage i works on microbatch (s - i)
+    when 0 <= s - i < n_micro, else idles on zeros; after each step the
+    activation buffer rotates +1 around the ring.
+
+The whole schedule is a single ``lax.scan`` (compiler-friendly, no Python
+step loop), differentiable end-to-end — the backward pass that autodiff
+derives through the scan+ppermute IS the reverse pipeline schedule (1B1F
+order with stashed activations, which is what remat policies then trade
+memory against). Bubble fraction is the usual (pp-1)/(n_micro+pp-1);
+choose n_micro >= 4*pp to keep it under ~20%.
+
+Restriction: the pipelined body must be *homogeneous* across stages (same
+param pytree structure per layer) so per-stage params stack into one
+leading-axis array. The flagship all-linear LM satisfies this; hybrid
+swa/linear models do not (their pp support would stack per-type subsets —
+future work, noted in SURVEY §7).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+Array = jax.Array
+
+
+def stack_params(per_layer_params: list) -> Any:
+    """[p_0, ..., p_{L-1}] (same structure) -> one pytree with leading
+    layer axis L on every leaf. Shard that axis over pp."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_layer_params)
+
+
+def unstack_params(stacked: Any, n: int) -> list:
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def _stage_apply(layer_fn: Callable, stage_params: Any, x: Array) -> Array:
+    """Run this device's stack of layers_per_stage layers sequentially.
+    stage_params leaves: [layers_per_stage, ...]."""
+
+    def body(h, layer_params):
+        return layer_fn(layer_params, h), None
+
+    out, _ = lax.scan(body, x, stage_params)
+    return out
+
+
+def pipeline_apply(
+    stacked_params: Any,
+    x: Array,
+    layer_fn: Callable[[Any, Array], Array],
+    mesh: Mesh,
+    *,
+    n_micro: int,
+    axis: str = "pp",
+) -> Array:
+    """Apply L stacked layers to ``x`` [B, ...] as a pp-stage pipeline.
+
+    ``stacked_params``: every leaf [L, ...] with L % pp == 0; leading axis
+    sharded over ``axis`` (stage i holds layers [i*L/pp, (i+1)*L/pp)).
+    ``x``: microbatch axis comes from splitting B into n_micro groups;
+    B % n_micro == 0. Returns the transformed [B, ...], layer order
+    preserved (stage order == ring order).
+    """
+    pp = mesh.shape[axis]
+    if pp == 1:
+        return _stage_apply(layer_fn, stacked_params, x)
+    b = x.shape[0]
+    assert b % n_micro == 0, (b, n_micro)
+    leaves = jax.tree.leaves(stacked_params)
+    n_layers = leaves[0].shape[0]
+    assert n_layers % pp == 0, (n_layers, pp)
+
+    def local(params_local, x_all):
+        """shard_map body. params_local leaves: [L/pp, ...] (this stage's
+        layers). x_all: the FULL batch [B, ...] (replicated over pp) —
+        each stage computes every microbatch but only its own stage slice,
+        so the activation ring carries one microbatch-sized buffer."""
+        i = lax.axis_index(axis)
+        micro = x_all.reshape(n_micro, b // n_micro, *x_all.shape[1:])
+        # the scan carry is device-varying (each stage holds different
+        # activations); mark the replicated initializers/input accordingly
+        # so shard_map's varying-mesh-axes check can verify the body
+        if hasattr(lax, "pcast"):
+            micro = lax.pcast(micro, (axis,), to="varying")
+        else:  # older jax spelling
+            micro = lax.pvary(micro, (axis,))
+
+        n_steps = n_micro + pp - 1
+        zeros = jnp.zeros_like(micro[0])
+        out0 = jnp.zeros_like(micro)
+
+        def step(carry, s):
+            buf, outs = carry
+            # stage 0 injects microbatch s from the source; others take the
+            # rotated buffer (their left neighbor's last output)
+            m_idx = jnp.clip(s, 0, n_micro - 1)
+            inj = lax.dynamic_index_in_dim(micro, m_idx, keepdims=False)
+            h_in = jnp.where(i == 0, inj, buf)
+            active = (s - i >= 0) & (s - i < n_micro)
+            h_out = _stage_apply(layer_fn, params_local, h_in)
+            h_out = jnp.where(active, h_out, zeros)
+            # last stage banks its finished microbatch (s - (pp-1))
+            o_idx = jnp.clip(s - (pp - 1), 0, n_micro - 1)
+            bank = (i == pp - 1) & (s - (pp - 1) >= 0)
+            prev = lax.dynamic_index_in_dim(outs, o_idx, axis=0, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(bank, h_out, prev), o_idx, axis=0
+            )
+            # rotate stage i -> i+1 (ICI neighbor hop)
+            nxt = lax.ppermute(
+                h_out, axis, [(j, (j + 1) % pp) for j in range(pp)]
+            )
+            return (nxt, outs), None
+
+        (_, outs), _ = lax.scan(step, (zeros, out0), jnp.arange(n_steps))
+        # every stage ran the scan; only the last stage's banked outputs are
+        # real — broadcast them back over pp so out_specs can be replicated
+        outs = lax.psum(jnp.where(i == pp - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs.reshape(b, *x_all.shape[1:])
+
+    pspec = jax.tree.map(lambda _: P(axis), stacked_params)
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+    )
+    return fn(stacked_params, x)
+
+
+__all__ = ["pipeline_apply", "stack_params", "unstack_params"]

@@ -1,0 +1,108 @@
+"""Pipeline-parallel forward for the TransformerLM (SURVEY.md P10).
+
+Adapter from the flax model to the GPipe primitive (pipeline.py): restack
+the per-block param subtrees onto a leading layer axis, embed on every
+stage (cheap, replicated), stream the block stack through the pp ring, and
+apply the head to the last stage's output. Valid for depth-homogeneous
+configs — every block the same layer type — which covers the flagship
+all-linear 1.3B (BASELINE.json config #4).
+
+Composes with autodiff: `pp_lm_loss` differentiates end-to-end, the
+backward being the reverse pipeline the scan+ppermute transpose yields.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from orion_tpu.models.transformer import Block, TransformerLM
+from orion_tpu.parallel.pipeline import pipeline_apply, stack_params
+
+Array = jax.Array
+
+
+def _homogeneous_type(cfg) -> str:
+    types = set(cfg.resolved_layer_types)
+    assert len(types) == 1, (
+        f"pipeline parallelism needs depth-homogeneous layers, got {types}; "
+        "hybrid models would need per-type stage stacks"
+    )
+    return next(iter(types))
+
+
+def stack_lm_blocks(model: TransformerLM, params: Any) -> Any:
+    """Pull block_0..block_{L-1} out of a TransformerLM param tree and stack
+    them on a leading layer axis (shard it over pp)."""
+    p = params["params"]
+    return stack_params([p[f"block_{i}"] for i in range(model.cfg.n_layers)])
+
+
+def pp_lm_logits(
+    model: TransformerLM,
+    params: Any,
+    tokens: Array,
+    mesh: Mesh,
+    *,
+    n_micro: int,
+    axis: str = "pp",
+    stacked_blocks: Optional[Any] = None,
+) -> Array:
+    """tokens [B, T] -> logits [B, T, V], blocks executed as a pp pipeline.
+
+    Matches ``model.apply(params, tokens)`` exactly (same submodules, same
+    dtypes); only the block loop is restructured. Embedding and head run
+    replicated on every stage — they are O(B·T·D) and O(B·T·V) matmuls that
+    GSPMD can additionally shard over other mesh axes.
+    """
+    cfg = model.cfg
+    lt = _homogeneous_type(cfg)
+    assert model.mesh is None, (
+        "pp_lm_logits needs a mesh-free model: TransformerLM(cfg, mesh=...) "
+        "bakes dp/fsdp sharding constraints into _embed that clash with the "
+        "pp-only shard_map mesh — build the model without a mesh for pipeline "
+        "runs"
+    )
+    assert cfg.dropout == 0.0, (
+        "pipeline forward has no dropout-rng plumbing yet; train pipelined "
+        "models with cfg.dropout == 0 (the non-pp Trainer supports dropout)"
+    )
+    if stacked_blocks is None:
+        stacked_blocks = stack_lm_blocks(model, params)
+
+    t = tokens.shape[-1]
+    x = model.apply(
+        params, tokens, jnp.arange(t), method=lambda m, tok, pos: m._embed(tok, pos)
+    )
+    block = Block(cfg, lt, True, None)
+
+    def layer_fn(block_params, h):
+        return block.apply({"params": block_params}, h)
+
+    x = pipeline_apply(
+        stacked_blocks, x, layer_fn, mesh, n_micro=n_micro, axis=axis
+    )
+    return model.apply(params, x, method=lambda m, h: m._head(h))
+
+
+def pp_lm_loss(
+    model: TransformerLM,
+    params: Any,
+    batch: Array,
+    mesh: Mesh,
+    *,
+    n_micro: int,
+    axis: str = "pp",
+) -> Array:
+    """batch [B, T+1] -> mean next-token cross entropy under the pipeline."""
+    import optax
+
+    x, y = batch[:, :-1], batch[:, 1:]
+    logits = pp_lm_logits(model, params, x, mesh, n_micro=n_micro, axis=axis)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+__all__ = ["pp_lm_logits", "pp_lm_loss", "stack_lm_blocks"]

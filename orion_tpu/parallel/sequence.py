@@ -116,8 +116,13 @@ def sp_linear_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        # pallas_call inside the body can't declare varying-mesh-axes on its
-        # out_shape; parity tests cover what the vma check would
+        # jax's pallas interpret-mode (the CPU test path) cannot run under
+        # check_vma=True: its internal dynamic_slice mixes varying operands
+        # with unvarying indices and jax itself says "as a temporary
+        # workaround pass check_vma=False" (hlo_interpreter.py). The kernel
+        # out_shapes do declare vma (ops/pallas/causal_dot.py::_sds), so
+        # flip this on once the interpreter is fixed; sp parity tests at
+        # 2/4/8 cover values+grads meanwhile.
         check_vma=False,
     )
     return fn(q, k, v)
