@@ -72,6 +72,23 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.orion_byte_encode_file.restype = ctypes.c_int64
     lib.orion_byte_encode_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    try:  # BPE entry points (absent in .so builds predating bpe.cc)
+        lib.orion_bpe_create.restype = ctypes.c_void_p
+        lib.orion_bpe_create.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+        ]
+        lib.orion_bpe_destroy.restype = None
+        lib.orion_bpe_destroy.argtypes = [ctypes.c_void_p]
+        lib.orion_bpe_encode.restype = ctypes.c_int64
+        lib.orion_bpe_encode.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+    except AttributeError:
+        pass
     _lib = lib
     return lib
 
@@ -142,6 +159,45 @@ def make_fastest_dataset(path: str, seq_len: int):
     return TokenBinDataset(path, seq_len)
 
 
+class NativeBPE:
+    """C++ BPE encoder (runtime/bpe.cc); token-for-token identical to the
+    Python ``utils/bpe.py`` encode path (contract-tested). Create from the
+    tokenizer's merge list; encode() takes/returns what the Python does."""
+
+    def __init__(self, merges):
+        lib = _load()
+        if lib is None or not hasattr(lib, "orion_bpe_create"):
+            raise ImportError("liborion_runtime.so missing BPE entry points")
+        flat = np.asarray(merges, dtype=np.int32).reshape(-1)
+        self._lib = lib
+        self._h = lib.orion_bpe_create(
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(merges)
+        )
+        if not self._h:
+            raise OSError("orion_bpe_create failed")
+
+    def encode(self, text: str):
+        data = text.encode("utf-8")
+        if not data:
+            return []
+        out = np.empty(len(data), dtype=np.int32)
+        n = self._lib.orion_bpe_encode(
+            self._h,
+            data,
+            len(data),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out[:n].tolist()
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.orion_bpe_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
 def byte_encode_file(in_path: str, out_path: str) -> int:
     """Stream a raw file into a uint16 token-bin (+ sidecar). Native if
     available, Python otherwise. Returns token count."""
@@ -164,6 +220,7 @@ __all__ = [
     "build",
     "native_available",
     "NativeTokenBinDataset",
+    "NativeBPE",
     "make_fastest_dataset",
     "byte_encode_file",
 ]

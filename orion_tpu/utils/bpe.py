@@ -41,6 +41,7 @@ class BPETokenizer:
             table.append(table[a] + table[b])
         self._bytes = table
         self._cache: Dict[bytes, List[int]] = {}
+        self._native = None  # lazily-bound runtime/bpe.cc encoder (or False)
 
     # -- vocab layout -------------------------------------------------------
 
@@ -78,6 +79,19 @@ class BPETokenizer:
         return parts
 
     def encode(self, text: str) -> List[int]:
+        if self._native is None:
+            try:  # C++ encode hot path (runtime/bpe.cc), identical output
+                from orion_tpu.runtime import NativeBPE
+
+                self._native = NativeBPE(self.merges)
+            except (ImportError, OSError):
+                self._native = False
+        if self._native:
+            return self._native.encode(text)
+        return self.encode_py(text)
+
+    def encode_py(self, text: str) -> List[int]:
+        """Pure-Python encode (the contract reference for runtime/bpe.cc)."""
         out: List[int] = []
         for m in _PRETOK.finditer(text.encode("utf-8")):
             out.extend(self._bpe_word(m.group(0)))

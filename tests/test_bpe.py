@@ -94,3 +94,99 @@ def test_prepare_data_bpe_and_train(tmp_path):
     res = evaluate_lm(TransformerLM(model), state.params, ds,
                       batch_size=8, n_batches=4)
     assert np.isfinite(res["eval_loss"]) and res["eval_ppl"] < 60.0, res
+
+
+def test_native_bpe_matches_python():
+    """runtime/bpe.cc contract: token-for-token identical to encode_py on
+    adversarial inputs (ws runs, UTF-8 multibyte, digits, mixed)."""
+    import pytest
+
+    from orion_tpu import runtime
+
+    if not runtime.native_available():
+        pytest.skip("native runtime not built")
+    if not hasattr(runtime._load(), "orion_bpe_create"):
+        pytest.skip("stale .so without BPE entry points")
+
+    tok = train_bpe(["the quick brown fox 123 jumps! over\n\nthe lazy dog " * 20,
+                     "naïve café — résumé ünïcode 例文 テスト " * 10], 400)
+    native = runtime.NativeBPE(tok.merges)
+    cases = [
+        "",
+        "the quick brown fox",
+        "   leading spaces",
+        "trailing spaces   ",
+        "tabs\tand\nnewlines\r\n",
+        "digits 123 and 456789 mixed a1b2c3",
+        "punct!!! ...and---symbols@#$",
+        "naïve café — résumé 例文 テスト",
+        " a",
+        "  a",
+        "a  ",
+        "\t\t",
+        "word" * 50,
+    ]
+    for text in cases:
+        assert native.encode(text) == tok.encode_py(text), repr(text)
+
+
+def test_native_bpe_speed_on_corpus_sample():
+    """The native encoder must at least reproduce a real-corpus slice
+    exactly (speed is informational, printed to stderr)."""
+    import json as _json
+    import sys
+    import time
+
+    import pytest
+
+    from orion_tpu import runtime
+    from orion_tpu.utils.bpe import BPETokenizer
+
+    if not runtime.native_available():
+        pytest.skip("native runtime not built")
+    if not hasattr(runtime._load(), "orion_bpe_create"):
+        pytest.skip("stale .so without BPE entry points")
+    import os
+
+    tok_path = os.path.join(os.path.dirname(__file__), "..", "data", "tok32k.json")
+    corpus = os.path.join(os.path.dirname(__file__), "..", "data", "corpus.jsonl")
+    if not (os.path.exists(tok_path) and os.path.exists(corpus)):
+        pytest.skip("worked-example data not present")
+    tok = BPETokenizer.load(tok_path)
+    with open(corpus) as f:
+        texts = [_json.loads(next(f))["text"] for _ in range(20)]
+    native = runtime.NativeBPE(tok.merges)
+    t0 = time.perf_counter()
+    got = [native.encode(t) for t in texts]
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ref = [tok.encode_py(t) for t in texts]
+    t_py = time.perf_counter() - t0
+    assert got == ref
+    nbytes = sum(len(t.encode()) for t in texts)
+    print(f"\nnative {nbytes/t_native/1e6:.1f} MB/s vs python "
+          f"{nbytes/t_py/1e6:.1f} MB/s", file=sys.stderr)
+
+
+def test_native_bpe_concurrent_encode():
+    """ctypes drops the GIL during encode; the C++ word cache is mutex-
+    guarded so concurrent encode() on one tokenizer stays correct."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import pytest
+
+    from orion_tpu import runtime
+
+    if not runtime.native_available():
+        pytest.skip("native runtime not built")
+    if not hasattr(runtime._load(), "orion_bpe_create"):
+        pytest.skip("stale .so without BPE entry points")
+
+    tok = train_bpe(["shared cache stress test words " * 50], 300)
+    native = runtime.NativeBPE(tok.merges)
+    texts = [f"shared cache stress test words {i} " * 30 for i in range(32)]
+    ref = [tok.encode_py(t) for t in texts]
+    with ThreadPoolExecutor(8) as ex:
+        for _ in range(3):  # repeated to give races a chance
+            got = list(ex.map(native.encode, texts))
+            assert got == ref
