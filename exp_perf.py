@@ -16,8 +16,9 @@ def run(tag, batch_size, seq_len=2048, iters=10, **model_kw):
 
     opt = model_kw.pop("optimizer", "lion")
     mu_dtype = model_kw.pop("mu_dtype", "bfloat16")
+    model_kw.setdefault("remat", True)
     model = dataclasses.replace(
-        get_config("lm_1b3"), max_seq_len=seq_len, remat=True, **model_kw
+        get_config("lm_1b3"), max_seq_len=seq_len, **model_kw
     )
     cfg = TrainConfig(
         model=model, steps=10**9, batch_size=batch_size, seq_len=seq_len,

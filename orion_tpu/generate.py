@@ -216,6 +216,14 @@ def main(argv=None) -> int:
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--tokenizer",
+        default=None,
+        help="BPE tokenizer JSON (from prepare_data --train-tokenizer) for "
+        "32k-vocab checkpoints; default byte-level",
+    )
+    p.add_argument("--eos", action="store_true",
+                   help="stop sequences at the tokenizer's <eos>")
     # same mesh flags as train.py / aot.py; any axis > 1 builds a mesh
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--fsdp", type=int, default=1)
@@ -226,17 +234,33 @@ def main(argv=None) -> int:
         if getattr(args, ax) < 1:
             p.error(f"--{ax} must be >= 1")
 
-    from orion_tpu.utils.tokenizer import ByteTokenizer
-
     cfg = get_config(args.config)
-    model = TransformerLM(cfg)
-    tok = ByteTokenizer()
+    eos_token = -1
+    if args.tokenizer:
+        from orion_tpu.utils.bpe import BPETokenizer
+
+        tok = BPETokenizer.load(args.tokenizer)
+        assert tok.vocab_size <= cfg.vocab_size, (
+            f"tokenizer vocab {tok.vocab_size} > model vocab {cfg.vocab_size}"
+        )
+        if args.eos:
+            eos_token = tok.eos
+    else:
+        from orion_tpu.utils.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
     prompt = jnp.asarray([tok.encode(args.prompt)], jnp.int32)
 
     if args.ckpt_dir:
         params, step = load_params(args.ckpt_dir)
+        # match the checkpoint's positional capacity (train.py auto-bump)
+        pos_rows = params["params"]["pos_embed"]["embedding"].shape[0]
+        if pos_rows != cfg.max_seq_len:
+            cfg = dataclasses.replace(cfg, max_seq_len=pos_rows)
         print(f"loaded step {step} from {args.ckpt_dir}", file=sys.stderr)
+        model = TransformerLM(cfg)
     else:
+        model = TransformerLM(cfg)
         params = model.init(jax.random.PRNGKey(0), prompt)
         print("no --ckpt-dir: random params (smoke test)", file=sys.stderr)
 
@@ -254,11 +278,14 @@ def main(argv=None) -> int:
         params,
         prompt,
         args.max_new_tokens,
-        SampleConfig(args.temperature, args.top_k, args.top_p),
+        SampleConfig(args.temperature, args.top_k, args.top_p, eos_token=eos_token),
         jax.random.PRNGKey(args.seed),
         mesh=mesh,
     )
-    print(args.prompt + tok.decode([int(t) for t in out[0]]))
+    ids = [int(t) for t in out[0]]
+    if eos_token >= 0 and eos_token in ids:
+        ids = ids[: ids.index(eos_token)]
+    print(args.prompt + tok.decode(ids))
     return 0
 
 
