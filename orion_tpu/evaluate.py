@@ -20,12 +20,14 @@ from orion_tpu.models.transformer import TransformerLM
 from orion_tpu.training.data import make_dataset
 
 
-def lm_eval_sums(model: TransformerLM, params, batch):
+def lm_eval_sums(model: TransformerLM, params, batch, logits_fn=None):
     """batch [B, T+1] -> (sum of next-token xent, token count). The single
     eval-loss definition — Trainer._eval_step delegates here too, so the
-    periodic in-training eval and this CLI can never drift apart."""
+    periodic in-training eval and this CLI can never drift apart.
+    ``logits_fn(model, params, x)`` overrides the forward (the pp Trainer
+    passes the pipelined one); default is the plain parallel forward."""
     x, y = batch[:, :-1], batch[:, 1:]
-    logits = model.apply(params, x)
+    logits = model.apply(params, x) if logits_fn is None else logits_fn(model, params, x)
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
     return losses.sum(), jnp.asarray(losses.size, jnp.float32)
 

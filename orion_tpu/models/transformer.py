@@ -39,6 +39,13 @@ from orion_tpu.ops.softmax_attention import cached_attention, softmax_attention
 Array = jax.Array
 State = Dict[str, Array]
 
+# remat_policy name -> jax.checkpoint policy; the single definition shared
+# by the model's per-block remat and the pipeline adapter (pipeline_lm.py)
+REMAT_POLICIES = {
+    "full": None,  # save only block boundaries, recompute all
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+}
+
 
 def _dtype(name: str):
     return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
@@ -325,12 +332,8 @@ class TransformerLM(nn.Module):
         self.pos_embed = nn.Embed(cfg.max_seq_len, cfg.d_model, param_dtype=pdt)
         block_cls = Block
         if cfg.remat:
-            policies = {
-                "full": None,  # save only block boundaries, recompute all
-                "dots": jax.checkpoint_policies.checkpoint_dots,
-            }
             block_cls = nn.remat(
-                Block, static_argnums=(3,), policy=policies[cfg.remat_policy]
+                Block, static_argnums=(3,), policy=REMAT_POLICIES[cfg.remat_policy]
             )
         self.blocks = [
             block_cls(cfg, lt, True, self.mesh, name=f"block_{i}")

@@ -144,6 +144,9 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(pspec, P()),
         out_specs=P(),
+        # partial-manual: only pp is manual here; dp/fsdp/tp stay automatic
+        # so this composes with GSPMD batch/tensor sharding in the trainer
+        axis_names=frozenset({axis}),
     )
     return fn(stacked_params, x)
 

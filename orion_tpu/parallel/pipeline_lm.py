@@ -1,11 +1,21 @@
 """Pipeline-parallel forward for the TransformerLM (SURVEY.md P10).
 
-Adapter from the flax model to the GPipe primitive (pipeline.py): restack
-the per-block param subtrees onto a leading layer axis, embed on every
-stage (cheap, replicated), stream the block stack through the pp ring, and
-apply the head to the last stage's output. Valid for depth-homogeneous
-configs — every block the same layer type — which covers the flagship
-all-linear 1.3B (BASELINE.json config #4).
+Adapter from the flax model to the GPipe primitive (pipeline.py): the
+per-block param subtrees live stacked on a leading layer axis (sharded over
+pp), the block stack streams through the pp ring, and embedding/head run on
+every stage (replicated over pp; still dp/fsdp/tp-sharded by GSPMD — the
+pipeline shard_map is partial-manual over pp only). Valid for
+depth-homogeneous configs — every block the same layer type — which covers
+the flagship all-linear 1.3B (BASELINE.json config #4).
+
+Two param layouts are accepted:
+- standard flax layout (block_0..block_{L-1}) — restacked on the fly
+  (a full param copy; fine for one-off calls, not per step), or
+- pipeline layout ({"blocks_stacked": ...} with no block_i entries) — the
+  Trainer's pp>1 native state format (training/trainer.py), zero-copy.
+
+``stack_lm_params``/``unstack_lm_params`` convert checkpoints between the
+two layouts (e.g. to serve a pp-trained checkpoint with generate.py).
 
 Composes with autodiff: `pp_lm_loss` differentiates end-to-end, the
 backward being the reverse pipeline the scan+ppermute transpose yields.
@@ -20,7 +30,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from orion_tpu.models.transformer import Block, TransformerLM
-from orion_tpu.parallel.pipeline import pipeline_apply, stack_params
+from orion_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_params,
+    unstack_params,
+)
 
 Array = jax.Array
 
@@ -41,6 +55,24 @@ def stack_lm_blocks(model: TransformerLM, params: Any) -> Any:
     return stack_params([p[f"block_{i}"] for i in range(model.cfg.n_layers)])
 
 
+def stack_lm_params(model: TransformerLM, params: Any) -> Any:
+    """Standard layout -> pipeline layout: {"blocks_stacked": [L, ...], rest}."""
+    p = dict(params["params"])
+    blocks = [p.pop(f"block_{i}") for i in range(model.cfg.n_layers)]
+    p["blocks_stacked"] = stack_params(blocks)
+    return {**params, "params": p}
+
+
+def unstack_lm_params(model: TransformerLM, params: Any) -> Any:
+    """Pipeline layout -> standard layout (e.g. to serve a pp-trained
+    checkpoint with generate.py / evaluate.py)."""
+    p = dict(params["params"])
+    stacked = p.pop("blocks_stacked")
+    for i, bp in enumerate(unstack_params(stacked, model.cfg.n_layers)):
+        p[f"block_{i}"] = bp
+    return {**params, "params": p}
+
+
 def pp_lm_logits(
     model: TransformerLM,
     params: Any,
@@ -49,29 +81,26 @@ def pp_lm_logits(
     *,
     n_micro: int,
     axis: str = "pp",
-    stacked_blocks: Optional[Any] = None,
 ) -> Array:
     """tokens [B, T] -> logits [B, T, V], blocks executed as a pp pipeline.
 
     Matches ``model.apply(params, tokens)`` exactly (same submodules, same
-    dtypes); only the block loop is restructured. Embedding and head run
-    replicated on every stage — they are O(B·T·D) and O(B·T·V) matmuls that
-    GSPMD can additionally shard over other mesh axes.
+    dtypes); only the block loop is restructured.
     """
     cfg = model.cfg
     lt = _homogeneous_type(cfg)
-    assert model.mesh is None, (
-        "pp_lm_logits needs a mesh-free model: TransformerLM(cfg, mesh=...) "
-        "bakes dp/fsdp sharding constraints into _embed that clash with the "
-        "pp-only shard_map mesh — build the model without a mesh for pipeline "
-        "runs"
+    assert model.mesh is None or model.mesh is mesh, (
+        "pp_lm_logits: the model was built with a different mesh than the "
+        "pipeline's — _embed's sharding constraints would clash; pass the "
+        "same mesh to both (Trainer does) or build the model without one"
     )
     assert cfg.dropout == 0.0, (
         "pipeline forward has no dropout-rng plumbing yet; train pipelined "
         "models with cfg.dropout == 0 (the non-pp Trainer supports dropout)"
     )
-    if stacked_blocks is None:
-        stacked_blocks = stack_lm_blocks(model, params)
+    stacked = params["params"].get("blocks_stacked")
+    if stacked is None:
+        stacked = stack_lm_blocks(model, params)
 
     t = tokens.shape[-1]
     x = model.apply(
@@ -82,8 +111,15 @@ def pp_lm_logits(
     def layer_fn(block_params, h):
         return block.apply({"params": block_params}, h)
 
+    if cfg.remat:  # same per-block policies as the non-pp model
+        from orion_tpu.models.transformer import REMAT_POLICIES
+
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=REMAT_POLICIES[cfg.remat_policy]
+        )
+
     x = pipeline_apply(
-        stacked_blocks, x, layer_fn, mesh, n_micro=n_micro, axis=axis
+        stacked, x, layer_fn, mesh, n_micro=n_micro, axis=axis
     )
     return model.apply(params, x, method=lambda m, h: m._head(h))
 
@@ -105,4 +141,10 @@ def pp_lm_loss(
     return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
 
-__all__ = ["pp_lm_logits", "pp_lm_loss", "stack_lm_blocks"]
+__all__ = [
+    "pp_lm_logits",
+    "pp_lm_loss",
+    "stack_lm_blocks",
+    "stack_lm_params",
+    "unstack_lm_params",
+]

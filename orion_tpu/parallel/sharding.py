@@ -37,6 +37,14 @@ _RULES = (
 
 
 def spec_for_path(path: str) -> P:
+    # pipeline-stacked blocks ("blocks_stacked/<block subtree>"): the extra
+    # leading layer axis shards over pp; the remaining dims follow the same
+    # per-layer rules
+    if "blocks_stacked/" in path:
+        suffix = path.split("blocks_stacked/", 1)[1]
+        for pat, spec in _RULES:
+            if re.search(pat, suffix):
+                return P("pp", *spec)
     for pat, spec in _RULES:
         if re.search(pat, path):
             return spec

@@ -96,6 +96,8 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--fsdp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (depth-homogeneous models)")
     p.add_argument("--distributed", action="store_true", help="multi-host init")
     p.add_argument(
         "--set", action="append", default=[], metavar="KEY=VALUE",
@@ -122,7 +124,8 @@ def main(argv=None) -> int:
         lr=args.lr,
         seed=args.seed,
         ckpt_dir=args.ckpt_dir,
-        mesh=MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp),
+        mesh=MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp,
+                        pp=args.pp),
     )
     if args.config_json:
         cfg = apply_overrides(cfg, load_json_overrides(args.config_json))

@@ -62,6 +62,9 @@ class TrainConfig:
     min_lr_ratio: float = 0.1
     # parallelism
     mesh: MeshConfig = MeshConfig()
+    # pipeline parallelism (mesh.pp > 1): number of GPipe microbatches;
+    # 0 = auto (4*pp capped at batch_size). Bubble = (pp-1)/(n_micro+pp-1).
+    pp_microbatches: int = 0
     # bookkeeping
     seed: int = 0
     log_every: int = 10
@@ -117,7 +120,10 @@ def _wd_mask(params: Any) -> Any:
 
     def mask(path, leaf):
         name = "/".join(str(getattr(k, "key", k)) for k in path)
-        return leaf.ndim >= 2 and "favor_proj" not in name
+        # pipeline layout stacks a leading layer axis: a stacked norm scale
+        # is [L, d] — still "not a matrix" per-layer, so shift the threshold
+        min_ndim = 3 if "blocks_stacked" in name else 2
+        return leaf.ndim >= min_ndim and "favor_proj" not in name
 
     return jax.tree_util.tree_map_with_path(mask, params)
 
@@ -188,6 +194,33 @@ class Trainer:
         # constraints; the sp attention path additionally gates on
         # cfg.sequence_parallel and mesh sp-axis size > 1
         self.model = TransformerLM(cfg.model, mesh=self.mesh)
+        # pipeline parallelism: blocks run as a GPipe pipeline over the pp
+        # axis and the state stores block params STACKED on a leading layer
+        # axis sharded over pp (parallel/pipeline_lm.py)
+        self.pp = self.mesh.shape.get("pp", 1)
+        if self.pp > 1:
+            assert len(set(cfg.model.resolved_layer_types)) == 1, (
+                "mesh.pp > 1 needs depth-homogeneous layers, got "
+                f"{set(cfg.model.resolved_layer_types)}"
+            )
+            assert cfg.model.dropout == 0.0, "pp has no dropout-rng plumbing"
+            assert not (
+                cfg.model.sequence_parallel and self.mesh.shape.get("sp", 1) > 1
+            ), "pp + sp composition is not supported yet"
+            # the pipeline sees one accumulation micro-batch at a time, so
+            # GPipe microbatches must divide cfg.micro_batch, not batch_size
+            base = cfg.micro_batch
+            if cfg.pp_microbatches:
+                self.pp_n_micro = cfg.pp_microbatches
+            else:  # auto: largest divisor of base not exceeding 4*pp
+                cap = max(1, min(base, 4 * self.pp))
+                self.pp_n_micro = max(
+                    d for d in range(1, cap + 1) if base % d == 0
+                )
+            assert base % self.pp_n_micro == 0, (
+                f"pp_microbatches={self.pp_n_micro} must divide the "
+                f"per-accumulation batch {base}"
+            )
         self.tx = make_optimizer(cfg)
         self.sched = make_schedule(cfg)
         self.batch_shd = batch_sharding(self.mesh)
@@ -200,6 +233,10 @@ class Trainer:
 
         def init_fn(rng):
             params = self.model.init(rng, sample_tokens)
+            if self.pp > 1:
+                from orion_tpu.parallel.pipeline_lm import stack_lm_params
+
+                params = stack_lm_params(self.model, params)
             return TrainState(
                 step=jnp.zeros((), jnp.int32),
                 params=params,
@@ -239,6 +276,12 @@ class Trainer:
         step_rng = rngs.at_step(state.rng, state.step)
 
         def loss_for(params, b, r):
+            if self.pp > 1:
+                from orion_tpu.parallel.pipeline_lm import pp_lm_loss
+
+                return pp_lm_loss(
+                    self.model, params, b, self.mesh, n_micro=self.pp_n_micro
+                )
             return lm_loss(self.model, params, b, r if use_dropout else None)
 
         grad_fn = jax.value_and_grad(loss_for)
@@ -297,7 +340,14 @@ class Trainer:
     def _eval_step(self, params, batch: Array) -> Tuple[Array, Array]:
         from orion_tpu.evaluate import lm_eval_sums  # single eval-loss defn
 
-        return lm_eval_sums(self.model, params, batch)
+        logits_fn = None
+        if self.pp > 1:
+            from orion_tpu.parallel.pipeline_lm import pp_lm_logits
+
+            logits_fn = lambda m, p, x: pp_lm_logits(  # noqa: E731
+                m, p, x, self.mesh, n_micro=self.pp_n_micro
+            )
+        return lm_eval_sums(self.model, params, batch, logits_fn=logits_fn)
 
     # -- host API -----------------------------------------------------------
 
