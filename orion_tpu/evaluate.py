@@ -92,6 +92,11 @@ def main(argv=None) -> int:
         f"the checkpoint was trained with max_seq_len={cfg.max_seq_len}"
     )
     model = TransformerLM(cfg)
+    if "blocks_stacked" in params.get("params", {}):
+        # pipeline-trained checkpoint: convert to the standard layout
+        from orion_tpu.parallel.pipeline_lm import unstack_lm_params
+
+        params = unstack_lm_params(model, params)
     dataset = make_dataset(args.data, args.seq_len, cfg.vocab_size)
     res = evaluate_lm(model, params, dataset, args.batch_size, args.n_batches)
     res["step"] = step

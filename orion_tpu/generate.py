@@ -259,6 +259,12 @@ def main(argv=None) -> int:
             cfg = dataclasses.replace(cfg, max_seq_len=pos_rows)
         print(f"loaded step {step} from {args.ckpt_dir}", file=sys.stderr)
         model = TransformerLM(cfg)
+        if "blocks_stacked" in params.get("params", {}):
+            # pipeline-trained checkpoint: convert to the standard layout
+            from orion_tpu.parallel.pipeline_lm import unstack_lm_params
+
+            params = unstack_lm_params(model, params)
+            print("unstacked pipeline-layout checkpoint", file=sys.stderr)
     else:
         model = TransformerLM(cfg)
         params = model.init(jax.random.PRNGKey(0), prompt)

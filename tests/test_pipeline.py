@@ -211,3 +211,23 @@ def test_trainer_pp_accum_and_odd_batch():
     assert 4 % t2.pp_n_micro == 0
     m2 = t2.step(jnp.asarray(SyntheticDataset(64, 32).batch(0, 0, 16)))
     assert np.isfinite(float(m2["loss"]))
+
+
+def test_stack_unstack_roundtrip():
+    from orion_tpu.models.configs import ModelConfig
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.parallel.pipeline_lm import stack_lm_params, unstack_lm_params
+
+    cfg = ModelConfig(
+        name="rt", vocab_size=64, d_model=32, n_layers=3, n_heads=2,
+        max_seq_len=32, dtype="float32", backend="xla",
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    rt = unstack_lm_params(model, stack_lm_params(model, params))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        rt,
+        params,
+    )
+    assert "blocks_stacked" not in rt["params"]
