@@ -1,12 +1,16 @@
 """Pipeline-parallel forward for the TransformerLM (SURVEY.md P10).
 
 Adapter from the flax model to the GPipe primitive (pipeline.py): the
-per-block param subtrees live stacked on a leading layer axis (sharded over
+per-block param subtrees live stacked on a leading axis (sharded over
 pp), the block stack streams through the pp ring, and embedding/head run on
 every stage (replicated over pp; still dp/fsdp/tp-sharded by GSPMD — the
-pipeline shard_map is partial-manual over pp only). Valid for
-depth-homogeneous configs — every block the same layer type — which covers
-the flagship all-linear 1.3B (BASELINE.json config #4).
+pipeline shard_map is partial-manual over pp only).
+
+Heterogeneous depth patterns stack at the GROUP level: the smallest period
+g of the layer-type pattern (``stage_group``) makes groups of g consecutive
+blocks structurally identical, so both the all-linear 1.3B (g=1) and the
+hybrid 7B's swa,swa,swa,linear × 8 (g=4) pipeline — pp must divide
+n_layers/g.
 
 Two param layouts are accepted:
 - standard flax layout (block_0..block_{L-1}) — restacked on the fly
@@ -39,27 +43,43 @@ from orion_tpu.parallel.pipeline import (
 Array = jax.Array
 
 
-def _homogeneous_type(cfg) -> str:
-    types = set(cfg.resolved_layer_types)
-    assert len(types) == 1, (
-        f"pipeline parallelism needs depth-homogeneous layers, got {types}; "
-        "hybrid models would need per-type stage stacks"
-    )
-    return next(iter(types))
+def stage_group(cfg) -> int:
+    """Smallest period g such that the layer-type pattern repeats with
+    period g and g divides n_layers. Blocks are stacked in GROUPS of g —
+    a group's param structure is identical across depth even for hybrid
+    patterns (e.g. the 7B's swa,swa,swa,linear × 8 has g=4), which is what
+    lets heterogeneous models pipeline. Homogeneous models get g=1."""
+    lts = cfg.resolved_layer_types
+    n = len(lts)
+    for g in range(1, n):
+        if n % g == 0 and all(lts[i] == lts[i % g] for i in range(n)):
+            return g
+    return n  # aperiodic pattern: one group of all layers (pp=1 only)
 
 
 def stack_lm_blocks(model: TransformerLM, params: Any) -> Any:
     """Pull block_0..block_{L-1} out of a TransformerLM param tree and stack
-    them on a leading layer axis (shard it over pp)."""
+    them on a leading group axis (shard it over pp). Each stacked element is
+    a group of ``stage_group(cfg)`` consecutive blocks ({"sub_0": ...})."""
     p = params["params"]
-    return stack_params([p[f"block_{i}"] for i in range(model.cfg.n_layers)])
+    g = stage_group(model.cfg)
+    groups = [
+        {
+            f"sub_{j}": p[f"block_{k * g + j}"]
+            for j in range(g)
+        }
+        for k in range(model.cfg.n_layers // g)
+    ]
+    return stack_params(groups)
 
 
 def stack_lm_params(model: TransformerLM, params: Any) -> Any:
-    """Standard layout -> pipeline layout: {"blocks_stacked": [L, ...], rest}."""
+    """Standard layout -> pipeline layout: {"blocks_stacked": [L/g, ...], rest}."""
+    stacked = stack_lm_blocks(model, params)
     p = dict(params["params"])
-    blocks = [p.pop(f"block_{i}") for i in range(model.cfg.n_layers)]
-    p["blocks_stacked"] = stack_params(blocks)
+    for i in range(model.cfg.n_layers):
+        p.pop(f"block_{i}")
+    p["blocks_stacked"] = stacked
     return {**params, "params": p}
 
 
@@ -68,8 +88,14 @@ def unstack_lm_params(model: TransformerLM, params: Any) -> Any:
     checkpoint with generate.py / evaluate.py)."""
     p = dict(params["params"])
     stacked = p.pop("blocks_stacked")
-    for i, bp in enumerate(unstack_params(stacked, model.cfg.n_layers)):
-        p[f"block_{i}"] = bp
+    g = stage_group(model.cfg)
+    if "sub_0" not in stacked:
+        # pre-group layout (plain stacked block trees, g==1 era): wrap so
+        # old pp checkpoints keep restoring
+        g, stacked = 1, {"sub_0": stacked}
+    for k, group in enumerate(unstack_params(stacked, model.cfg.n_layers // g)):
+        for j in range(g):
+            p[f"block_{k * g + j}"] = group[f"sub_{j}"]
     return {**params, "params": p}
 
 
@@ -88,7 +114,6 @@ def pp_lm_logits(
     dtypes); only the block loop is restructured.
     """
     cfg = model.cfg
-    lt = _homogeneous_type(cfg)
     assert model.mesh is None or model.mesh is mesh, (
         "pp_lm_logits: the model was built with a different mesh than the "
         "pipeline's — _embed's sharding constraints would clash; pass the "
@@ -106,14 +131,23 @@ def pp_lm_logits(
     x = model.apply(
         params, tokens, jnp.arange(t), method=lambda m, tok, pos: m._embed(tok, pos)
     )
-    block = Block(cfg, lt, True, None)
+    g = stage_group(cfg)
+    blocks = [
+        Block(cfg, cfg.resolved_layer_types[j], True, None) for j in range(g)
+    ]
 
-    def layer_fn(block_params, h):
-        return block.apply({"params": block_params}, h)
+    def layer_fn(group_params, h):
+        for j, blk in enumerate(blocks):
+            h = blk.apply({"params": group_params[f"sub_{j}"]}, h)
+        return h
 
-    if cfg.remat:  # same per-block policies as the non-pp model
+    if cfg.remat:
         from orion_tpu.models.transformer import REMAT_POLICIES
 
+        # NB remat granularity here is per GROUP of g blocks (the pipeline's
+        # unit of work), not per block like the non-pp model — for g>1 the
+        # backward recomputes g blocks as one unit, so peak recompute memory
+        # is ~g blocks of activations
         layer_fn = jax.checkpoint(
             layer_fn, policy=REMAT_POLICIES[cfg.remat_policy]
         )

@@ -145,9 +145,20 @@ class DataLoader:
 
     def _worker(self):
         step = self.step
+        multihost = jax.process_count() > 1
         while not self._stop.is_set():
             host = self.dataset.batch(self.seed, step, self.batch_size)
-            if self.sharding is not None:
+            if self.sharding is not None and multihost:
+                # multi-host: a plain device_put of globally-sharded data
+                # would need non-addressable devices. Sampling is a pure
+                # function of (seed, step, row), so every process assembles
+                # the same global batch and materializes only the shards it
+                # owns — no cross-host data exchange, bit-identical global
+                # array (SURVEY.md P7).
+                batch = jax.make_array_from_callback(
+                    host.shape, self.sharding, lambda idx: host[idx]
+                )
+            elif self.sharding is not None:
                 batch = jax.device_put(host, self.sharding)
             else:
                 batch = jax.device_put(host)

@@ -199,9 +199,14 @@ class Trainer:
         # axis sharded over pp (parallel/pipeline_lm.py)
         self.pp = self.mesh.shape.get("pp", 1)
         if self.pp > 1:
-            assert len(set(cfg.model.resolved_layer_types)) == 1, (
-                "mesh.pp > 1 needs depth-homogeneous layers, got "
-                f"{set(cfg.model.resolved_layer_types)}"
+            from orion_tpu.parallel.pipeline_lm import stage_group
+
+            g = stage_group(cfg.model)
+            n_groups = cfg.model.n_layers // g
+            assert n_groups % self.pp == 0, (
+                f"pp={self.pp} must divide the {n_groups} stage groups "
+                f"(layer pattern repeats with period {g} over "
+                f"{cfg.model.n_layers} layers)"
             )
             assert cfg.model.dropout == 0.0, "pp has no dropout-rng plumbing"
             assert not (

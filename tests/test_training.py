@@ -214,3 +214,24 @@ def test_evaluate_cli_roundtrip(tmp_path):
     model = TransformerLM(cfg.model)
     res = evaluate_lm(model, params, ds, batch_size=2, n_batches=2)
     assert np.isfinite(res["eval_loss"]) and res["tokens"] > 0
+
+
+def test_loader_callback_path_matches_device_put():
+    """The multi-host materialization path (make_array_from_callback over
+    the addressable shards) must produce the same global array the single-
+    host device_put does — verified on the virtual 8-device mesh."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+    from orion_tpu.training.data import SyntheticDataset
+
+    mesh = make_mesh(MeshConfig(dp=4, fsdp=2))
+    shd = NamedSharding(mesh, P(("dp", "fsdp")))
+    ds = SyntheticDataset(64, 16)
+    host = ds.batch(0, 3, 8)
+    a = jax.device_put(host, shd)
+    b = jax.make_array_from_callback(host.shape, shd, lambda idx: host[idx])
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert b.sharding == shd
