@@ -235,3 +235,21 @@ def test_loader_callback_path_matches_device_put():
     b = jax.make_array_from_callback(host.shape, shd, lambda idx: host[idx])
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert b.sharding == shd
+
+
+def test_train_cli_smoke_with_pp(tmp_path):
+    """The full train.py CLI path (arg parsing, mesh build incl. --pp,
+    loader, metrics) runs end-to-end on the virtual mesh."""
+    from orion_tpu.train import main
+
+    log = str(tmp_path / "m.jsonl")
+    rc = main([
+        "--config", "tiny", "--data", "synthetic", "--steps", "3",
+        "--batch-size", "4", "--seq-len", "32", "--pp", "2", "--dp", "2",
+        "--log-path", log,
+    ])
+    assert rc == 0
+    import json as _json
+
+    lines = [_json.loads(l) for l in open(log)]
+    assert lines and all("loss" in l for l in lines)
