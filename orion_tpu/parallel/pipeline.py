@@ -53,25 +53,37 @@ def unstack_params(stacked: Any, n: int) -> list:
     return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
 
 
-def _stage_apply(layer_fn: Callable, stage_params: Any, x: Array) -> Array:
+def _stage_apply(
+    layer_fn: Callable, stage_params: Any, x: Array, rng: Any = None
+) -> Array:
     """Run this device's stack of layers_per_stage layers sequentially.
-    stage_params leaves: [layers_per_stage, ...]."""
+    stage_params leaves: [layers_per_stage, ...]. With ``rng``, layer_fn is
+    called as layer_fn(params, h, key) with a key folded per layer slot."""
+    n = jax.tree.leaves(stage_params)[0].shape[0]
 
-    def body(h, layer_params):
-        return layer_fn(layer_params, h), None
+    if rng is None:
+        def body(h, layer_params):
+            return layer_fn(layer_params, h), None
 
-    out, _ = lax.scan(body, x, stage_params)
+        out, _ = lax.scan(body, x, stage_params)
+    else:
+        def body(h, inp):
+            layer_params, slot = inp
+            return layer_fn(layer_params, h, jax.random.fold_in(rng, slot)), None
+
+        out, _ = lax.scan(body, x, (stage_params, jnp.arange(n)))
     return out
 
 
 def pipeline_apply(
     stacked_params: Any,
     x: Array,
-    layer_fn: Callable[[Any, Array], Array],
+    layer_fn: Callable,  # (params, h) -> h, or (params, h, key) -> h with rng
     mesh: Mesh,
     *,
     n_micro: int,
     axis: str = "pp",
+    rng: Any = None,
 ) -> Array:
     """Apply L stacked layers to ``x`` [B, ...] as a pp-stage pipeline.
 
@@ -80,10 +92,19 @@ def pipeline_apply(
     ``x``: microbatch axis comes from splitting B into n_micro groups;
     B % n_micro == 0. Returns the transformed [B, ...], layer order
     preserved (stage order == ring order).
+
+    ``rng``: stochastic-layer support (dropout). layer_fn is then called as
+    layer_fn(params, h, key), key = fold(fold(fold(rng, microbatch), stage),
+    within-stage slot) — unique per layer×microbatch, so every draw is
+    independent. NB *statistically* equivalent to the non-pipelined forward,
+    not bit-identical (and not reproducible across different pp values):
+    the non-pp model draws one [B, ...] mask per layer, the pipeline draws
+    per-microbatch masks; the pp==1 fast path folds per layer slot only
+    (whole-batch masks, like non-pp).
     """
     pp = mesh.shape[axis]
     if pp == 1:
-        return _stage_apply(layer_fn, stacked_params, x)
+        return _stage_apply(layer_fn, stacked_params, x, rng)
     b = x.shape[0]
     assert b % n_micro == 0, (b, n_micro)
     leaves = jax.tree.leaves(stacked_params)
@@ -117,7 +138,13 @@ def pipeline_apply(
             inj = lax.dynamic_index_in_dim(micro, m_idx, keepdims=False)
             h_in = jnp.where(i == 0, inj, buf)
             active = (s - i >= 0) & (s - i < n_micro)
-            h_out = _stage_apply(layer_fn, params_local, h_in)
+            step_rng = None
+            if rng is not None:
+                # distinct key per (microbatch, stage); _stage_apply folds
+                # the within-stage slot on top -> unique per layer×micro
+                m = jnp.clip(s - i, 0, n_micro - 1)
+                step_rng = jax.random.fold_in(jax.random.fold_in(rng, m), i)
+            h_out = _stage_apply(layer_fn, params_local, h_in, step_rng)
             h_out = jnp.where(active, h_out, zeros)
             # last stage banks its finished microbatch (s - (pp-1))
             o_idx = jnp.clip(s - (pp - 1), 0, n_micro - 1)

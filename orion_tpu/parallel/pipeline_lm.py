@@ -107,21 +107,20 @@ def pp_lm_logits(
     *,
     n_micro: int,
     axis: str = "pp",
+    dropout_rng: Any = None,
 ) -> Array:
     """tokens [B, T] -> logits [B, T, V], blocks executed as a pp pipeline.
 
     Matches ``model.apply(params, tokens)`` exactly (same submodules, same
-    dtypes); only the block loop is restructured.
+    dtypes); only the block loop is restructured. ``dropout_rng`` enables
+    dropout (statistically equivalent to the non-pp forward: per-microbatch
+    masks — see pipeline_apply).
     """
     cfg = model.cfg
     assert model.mesh is None or model.mesh is mesh, (
         "pp_lm_logits: the model was built with a different mesh than the "
         "pipeline's — _embed's sharding constraints would clash; pass the "
         "same mesh to both (Trainer does) or build the model without one"
-    )
-    assert cfg.dropout == 0.0, (
-        "pipeline forward has no dropout-rng plumbing yet; train pipelined "
-        "models with cfg.dropout == 0 (the non-pp Trainer supports dropout)"
     )
     stacked = params["params"].get("blocks_stacked")
     if stacked is None:
@@ -136,10 +135,21 @@ def pp_lm_logits(
         Block(cfg, cfg.resolved_layer_types[j], True, None) for j in range(g)
     ]
 
-    def layer_fn(group_params, h):
-        for j, blk in enumerate(blocks):
-            h = blk.apply({"params": group_params[f"sub_{j}"]}, h)
-        return h
+    if dropout_rng is None:
+        def layer_fn(group_params, h):
+            for j, blk in enumerate(blocks):
+                h = blk.apply({"params": group_params[f"sub_{j}"]}, h)
+            return h
+    else:
+        def layer_fn(group_params, h, key):
+            for j, blk in enumerate(blocks):
+                h = blk.apply(
+                    {"params": group_params[f"sub_{j}"]},
+                    h,
+                    deterministic=False,
+                    rngs={"dropout": jax.random.fold_in(key, j)},
+                )
+            return h
 
     if cfg.remat:
         from orion_tpu.models.transformer import REMAT_POLICIES
@@ -153,7 +163,8 @@ def pp_lm_logits(
         )
 
     x = pipeline_apply(
-        stacked, x, layer_fn, mesh, n_micro=n_micro, axis=axis
+        stacked, x, layer_fn, mesh, n_micro=n_micro, axis=axis,
+        rng=dropout_rng,
     )
     return model.apply(params, x, method=lambda m, h: m._head(h))
 
@@ -166,12 +177,16 @@ def pp_lm_loss(
     *,
     n_micro: int,
     axis: str = "pp",
+    dropout_rng: Any = None,
 ) -> Array:
     """batch [B, T+1] -> mean next-token cross entropy under the pipeline."""
     import optax
 
     x, y = batch[:, :-1], batch[:, 1:]
-    logits = pp_lm_logits(model, params, x, mesh, n_micro=n_micro, axis=axis)
+    logits = pp_lm_logits(
+        model, params, x, mesh, n_micro=n_micro, axis=axis,
+        dropout_rng=dropout_rng,
+    )
     return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
 

@@ -208,7 +208,6 @@ class Trainer:
                 f"(layer pattern repeats with period {g} over "
                 f"{cfg.model.n_layers} layers)"
             )
-            assert cfg.model.dropout == 0.0, "pp has no dropout-rng plumbing"
             assert not (
                 cfg.model.sequence_parallel and self.mesh.shape.get("sp", 1) > 1
             ), "pp + sp composition is not supported yet"
@@ -285,7 +284,9 @@ class Trainer:
                 from orion_tpu.parallel.pipeline_lm import pp_lm_loss
 
                 return pp_lm_loss(
-                    self.model, params, b, self.mesh, n_micro=self.pp_n_micro
+                    self.model, params, b, self.mesh,
+                    n_micro=self.pp_n_micro,
+                    dropout_rng=r if use_dropout else None,
                 )
             return lm_loss(self.model, params, b, r if use_dropout else None)
 
