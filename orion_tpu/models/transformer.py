@@ -61,12 +61,19 @@ class Attention(nn.Module):
     """One attention layer of type 'linear' | 'softmax' | 'swa'.
 
     ``mesh`` + cfg.sequence_parallel switches the causal parallel forward to
-    token-sharded execution over the mesh's sp axis (SURVEY.md P5/P6)."""
+    token-sharded execution over the mesh's sp axis (SURVEY.md P5/P6).
+
+    ``sp_local``: the caller is ALREADY inside a shard_map manual over sp
+    (the pp×sp pipeline body, parallel/pipeline_lm.py) and x carries the
+    sp-LOCAL token shard — run the sp bodies (sp_linear_attention_local /
+    ring_attention_local) directly instead of opening a nested shard_map,
+    which jax's sdy lowering rejects."""
 
     cfg: ModelConfig
     layer_type: str
     causal: bool = True
     mesh: Optional[Any] = None
+    sp_local: bool = False
 
     def setup(self):
         cfg = self.cfg
@@ -148,7 +155,17 @@ class Attention(nn.Module):
             assert t % self.mesh.shape["sp"] == 0, (t, dict(self.mesh.shape))
         if self.layer_type == "linear":
             qf, kf = self._phi_map(q), self._phi_map(k)
-            if sp:
+            if self.sp_local and self.causal:
+                from orion_tpu.parallel.sequence import sp_linear_attention_local
+
+                # the enclosing pipeline shard_map tracks vma (its transpose
+                # psums over pp), and pallas interpret mode can't trace under
+                # that check — run the XLA chunked form here; the pp×sp
+                # Pallas fast path needs real multi-chip hardware to validate
+                out = sp_linear_attention_local(
+                    qf, kf, v, backend="xla", chunk=cfg.chunk
+                )
+            elif sp:
                 from orion_tpu.parallel.sequence import sp_linear_attention
 
                 out = sp_linear_attention(
@@ -162,11 +179,21 @@ class Attention(nn.Module):
                 km = None if mask is None else mask[:, None, :]
                 out = linear_attention_noncausal(qf, kf, v, mask=km)
         else:
-            ang = self.freqs[:t]
+            if self.sp_local:
+                # x is the sp-LOCAL token shard: rotary needs the global
+                # positions of this shard's rows
+                i = jax.lax.axis_index("sp")
+                ang = jax.lax.dynamic_slice_in_dim(self.freqs, i * t, t, axis=0)
+            else:
+                ang = self.freqs[:t]
             q = apply_rotary(q, ang)
             k = apply_rotary(k, ang)
             window = cfg.window if self.layer_type == "swa" else None
-            if sp:
+            if self.sp_local and self.causal:
+                from orion_tpu.parallel.ring import ring_attention_local
+
+                out = ring_attention_local(q, k, v, causal=True, window=window)
+            elif sp:
                 from orion_tpu.parallel.ring import ring_attention
 
                 out = ring_attention(
@@ -291,11 +318,13 @@ class Block(nn.Module):
     layer_type: str
     causal: bool = True
     mesh: Optional[Any] = None
+    sp_local: bool = False
 
     def setup(self):
         self.norm1 = _norm(self.cfg, "norm1")
         self.attn = Attention(
-            self.cfg, self.layer_type, self.causal, self.mesh, name="attn"
+            self.cfg, self.layer_type, self.causal, self.mesh,
+            self.sp_local, name="attn"
         )
         self.norm2 = _norm(self.cfg, "norm2")
         self.mlp = MLP(self.cfg, name="mlp")

@@ -84,6 +84,8 @@ def pipeline_apply(
     n_micro: int,
     axis: str = "pp",
     rng: Any = None,
+    extra_manual_axes: tuple = (),
+    x_spec: Any = None,
 ) -> Array:
     """Apply L stacked layers to ``x`` [B, ...] as a pp-stage pipeline.
 
@@ -101,9 +103,17 @@ def pipeline_apply(
     the non-pp model draws one [B, ...] mask per layer, the pipeline draws
     per-microbatch masks; the pp==1 fast path folds per layer slot only
     (whole-batch masks, like non-pp).
+
+    ``extra_manual_axes`` + ``x_spec``: make additional mesh axes manual
+    inside the pipeline body (jax's sdy lowering rejects nested manual
+    regions, so a layer_fn that needs sp collectives must have sp manual
+    HERE and run the sp-local attention bodies directly — the pp×sp
+    composition, parallel/pipeline_lm.py). ``x_spec`` places x w.r.t. the
+    manual axes (e.g. P(None, 'sp', None) to hand the body sp-local token
+    shards).
     """
     pp = mesh.shape[axis]
-    if pp == 1:
+    if pp == 1 and not extra_manual_axes:
         return _stage_apply(layer_fn, stacked_params, x, rng)
     b = x.shape[0]
     assert b % n_micro == 0, (b, n_micro)
@@ -144,6 +154,11 @@ def pipeline_apply(
                 # the within-stage slot on top -> unique per layer×micro
                 m = jnp.clip(s - i, 0, n_micro - 1)
                 step_rng = jax.random.fold_in(jax.random.fold_in(rng, m), i)
+                # extra manual axes (sp): each shard draws only its local
+                # slice, so the key must differ per shard or masks repeat
+                # along the sharded dim with 1/|axis| the intended entropy
+                for ax in extra_manual_axes:
+                    step_rng = jax.random.fold_in(step_rng, lax.axis_index(ax))
             h_out = _stage_apply(layer_fn, params_local, h_in, step_rng)
             h_out = jnp.where(active, h_out, zeros)
             # last stage banks its finished microbatch (s - (pp-1))
@@ -166,14 +181,21 @@ def pipeline_apply(
         return outs.reshape(b, *x_all.shape[1:])
 
     pspec = jax.tree.map(lambda _: P(axis), stacked_params)
+    xs = P() if x_spec is None else x_spec
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
-        # partial-manual: only pp is manual here; dp/fsdp/tp stay automatic
-        # so this composes with GSPMD batch/tensor sharding in the trainer
-        axis_names=frozenset({axis}),
+        in_specs=(pspec, xs),
+        out_specs=xs,
+        # partial-manual: pp (and any extra axes the body's collectives
+        # need, e.g. sp) are manual; dp/fsdp/tp stay automatic so this
+        # composes with GSPMD batch/tensor sharding in the trainer
+        axis_names=frozenset({axis}) | frozenset(extra_manual_axes),
+        # vma stays tracked: the transpose of the pp-replicated x input is a
+        # psum over pp, whose type rule *requires* tracked vma — so unlike
+        # sequence.py this shard_map cannot run check_vma=False, and the
+        # sp-local attention inside must avoid pallas interpret mode (which
+        # can't trace under the check; transformer.py forces xla there)
     )
     return fn(stacked_params, x)
 

@@ -131,8 +131,14 @@ def pp_lm_logits(
         params, tokens, jnp.arange(t), method=lambda m, tok, pos: m._embed(tok, pos)
     )
     g = stage_group(cfg)
+    sp_on = cfg.sequence_parallel and mesh.shape.get("sp", 1) > 1
+    if sp_on:
+        assert tokens.shape[-1] % mesh.shape["sp"] == 0, (
+            tokens.shape, dict(mesh.shape)
+        )
     blocks = [
-        Block(cfg, cfg.resolved_layer_types[j], True, None) for j in range(g)
+        Block(cfg, cfg.resolved_layer_types[j], True, None, sp_on)
+        for j in range(g)
     ]
 
     if dropout_rng is None:
@@ -162,9 +168,16 @@ def pp_lm_logits(
             layer_fn, policy=REMAT_POLICIES[cfg.remat_policy]
         )
 
+    from jax.sharding import PartitionSpec as P
+
     x = pipeline_apply(
         stacked, x, layer_fn, mesh, n_micro=n_micro, axis=axis,
         rng=dropout_rng,
+        # pp×sp: sp must be manual in the SAME shard_map (nested manual
+        # regions don't lower); blocks then run the sp-local attention
+        # bodies on sp-local token shards
+        extra_manual_axes=("sp",) if sp_on else (),
+        x_spec=P(None, "sp", None) if sp_on else None,
     )
     return model.apply(params, x, method=lambda m, h: m._head(h))
 

@@ -208,9 +208,13 @@ class Trainer:
                 f"(layer pattern repeats with period {g} over "
                 f"{cfg.model.n_layers} layers)"
             )
-            assert not (
-                cfg.model.sequence_parallel and self.mesh.shape.get("sp", 1) > 1
-            ), "pp + sp composition is not supported yet"
+            # pp+sp composes: the pipeline shard_map is manual over both
+            # axes and blocks run the sp-local attention bodies
+            # (parallel/pipeline_lm.py); seq_len must shard evenly
+            if cfg.model.sequence_parallel and self.mesh.shape.get("sp", 1) > 1:
+                assert cfg.seq_len % self.mesh.shape["sp"] == 0, (
+                    cfg.seq_len, dict(self.mesh.shape)
+                )
             # the pipeline sees one accumulation micro-batch at a time, so
             # GPipe microbatches must divide cfg.micro_batch, not batch_size
             base = cfg.micro_batch
@@ -233,7 +237,10 @@ class Trainer:
         self._init_rng = rngs.stream(root, "init")
         self._dropout_rng = rngs.stream(root, "dropout")
 
-        sample_tokens = jnp.zeros((1, cfg.seq_len), jnp.int32)
+        # init runs one forward for shape inference; its sample batch must
+        # divide the data axes (the sp shard_map asserts divisibility)
+        n_data = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
+        sample_tokens = jnp.zeros((n_data, cfg.seq_len), jnp.int32)
 
         def init_fn(rng):
             params = self.model.init(rng, sample_tokens)
