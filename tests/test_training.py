@@ -253,3 +253,37 @@ def test_train_cli_smoke_with_pp(tmp_path):
 
     lines = [_json.loads(l) for l in open(log)]
     assert lines and all("loss" in l for l in lines)
+
+
+def test_pp_checkpoint_serves_via_unstack(tmp_path):
+    """A pp-trained checkpoint (stacked-block layout) round-trips: saved by
+    the pp Trainer, restored, auto-unstacked, and evaluated with the plain
+    forward — eval sums match the pp trainer's own eval exactly."""
+    from orion_tpu.evaluate import lm_eval_sums
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.parallel.mesh import MeshConfig
+    from orion_tpu.parallel.pipeline_lm import unstack_lm_params
+    from orion_tpu.training.checkpoint import Checkpointer
+
+    cfg = small_cfg(
+        steps=3, ckpt_dir=str(tmp_path / "ck"), ckpt_every=3,
+        mesh=MeshConfig(dp=1, pp=2),
+    )
+    trainer = Trainer(cfg)
+    ds = SyntheticDataset(cfg.model.vocab_size, cfg.seq_len)
+    ckpt = Checkpointer(cfg.ckpt_dir, save_every=3, async_save=False)
+    trainer.train(_iter(ds, cfg), ckpt=ckpt)
+    ckpt.close()
+
+    from orion_tpu.generate import load_params
+
+    params, step = load_params(cfg.ckpt_dir)
+    assert step == 3
+    assert "blocks_stacked" in params["params"]
+    model = TransformerLM(cfg.model)
+    flat = unstack_lm_params(model, params)
+    batch = jnp.asarray(ds.batch(0, 0, 4))
+    s_flat, c_flat = lm_eval_sums(model, flat, batch)
+    s_pp, _ = trainer._eval_fn(trainer.state.params, batch)
+    np.testing.assert_allclose(float(s_flat), float(s_pp), rtol=2e-6)
+    assert float(c_flat) > 0
