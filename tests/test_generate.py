@@ -185,3 +185,36 @@ def test_sharded_generate_sampled_parity():
     mesh = make_mesh(MeshConfig(dp=4))
     out = generate(model, params, prompt, 6, cfg, rng=rng, mesh=mesh)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_generate_cli_from_checkpoint(tmp_path, capsys):
+    """The CLI path end-to-end from a saved checkpoint: load_params,
+    pos-capacity adaptation, decode, byte-tokenizer print."""
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.training.checkpoint import Checkpointer
+    from orion_tpu.training.data import SyntheticDataset
+    from orion_tpu.training.trainer import TrainConfig, Trainer
+    from orion_tpu.generate import main
+
+    from orion_tpu.parallel.mesh import MeshConfig
+
+    cfg = TrainConfig(
+        model=get_config("tiny"), steps=2, batch_size=2, seq_len=32,
+        lr=1e-3, warmup_steps=1, log_every=100,
+        ckpt_dir=str(tmp_path / "ck"), ckpt_every=2, mesh=MeshConfig(dp=1),
+    )
+    trainer = Trainer(cfg)
+    ds = SyntheticDataset(cfg.model.vocab_size, cfg.seq_len)
+    ckpt = Checkpointer(cfg.ckpt_dir, save_every=2, async_save=False)
+    for step in (1, 2):
+        trainer.step(jnp.asarray(ds.batch(0, step, 2)))
+        ckpt.maybe_save(step, trainer.state)
+    ckpt.close()
+
+    rc = main([
+        "--config", "tiny", "--ckpt-dir", cfg.ckpt_dir,
+        "--prompt", "ab", "--max-new-tokens", "4", "--temperature", "0.0",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.startswith("ab") and len(out.strip()) >= 2
