@@ -37,7 +37,7 @@ def _enable_compile_cache():
     enable_compile_cache(os.path.join(os.path.dirname(__file__), ".jax_cache"))
 
 
-def _build(batch_size: int, seq_len: int):
+def _build(batch_size: int, seq_len: int, config: str = "lm_1b3"):
     import jax.numpy as jnp
 
     from orion_tpu.models.configs import get_config
@@ -46,7 +46,7 @@ def _build(batch_size: int, seq_len: int):
     from orion_tpu.training.trainer import TrainConfig, Trainer
 
     model = dataclasses.replace(
-        get_config("lm_1b3"), max_seq_len=seq_len, remat=True
+        get_config(config), max_seq_len=seq_len, remat=True
     )
     cfg = TrainConfig(
         model=model,
@@ -77,11 +77,13 @@ def _n_params(trainer) -> float:
     )
 
 
-def bench_train(seq_len: int = 2048, iters: int = 10) -> dict:
+def bench_train(
+    seq_len: int = 2048, iters: int = 10, config: str = "lm_1b3"
+) -> dict:
     last_err = None
     for batch_size in (16, 8, 4, 2, 1):
         try:
-            trainer, batch = _build(batch_size, seq_len)
+            trainer, batch = _build(batch_size, seq_len, config)
             m = trainer.step(batch)  # compile + 1 step
             m = trainer.step(batch)  # warm
             float(m["loss"])  # readback barrier
@@ -148,6 +150,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser("bench")
     ap.add_argument("--kernels", action="store_true",
                     help="also run the Pallas-vs-XLA kernel micro-bench")
+    ap.add_argument("--moe", action="store_true",
+                    help="also bench the moe_1b3_8e sparse config")
     ap.add_argument("--quick", action="store_true",
                     help="train bench only, fewer iters")
     args = ap.parse_args(argv)
@@ -174,6 +178,18 @@ def main(argv=None) -> int:
 
         for row in run_all():
             print(json.dumps(row), file=sys.stderr)
+
+    if args.moe:
+        # sparse flagship: ~2.9B params, ~1.3B active/token (top-1 over 8
+        # experts on every other layer). The figure of merit is tokens/sec
+        # vs the dense 1.3B — how much of the dense throughput survives
+        # routing + double-width expert HBM traffic.
+        moe = bench_train(iters=5 if args.quick else 10, config="moe_1b3_8e")
+        moe["config"] = "moe_1b3_8e"
+        moe["vs_dense_lm1b3"] = round(
+            moe["tokens_per_sec"] / res["tokens_per_sec"], 4
+        )
+        print(json.dumps({"moe_detail": moe}), file=sys.stderr)
 
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0

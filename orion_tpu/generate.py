@@ -142,7 +142,26 @@ def generate(
     prefill and the decode scan — KV/ring caches come out batch- and
     head-sharded with no model changes. A batch that doesn't divide
     dp*fsdp is placed replicated instead (tp sharding still applies).
+
+    MoE models are served in the NO-DROP regime: training-time capacity
+    factors drop tokens in the parallel pass, but decode_step never drops
+    (capacity = batch), so serving with training capacity would make the
+    prompt's prefill inconsistent with its own continuation. Capacity
+    factor is raised to E/k for inference (capacity == group size — the
+    parallel forward then provably keeps every token; models/moe.py).
     """
+    cfg = model.cfg
+    if cfg.n_experts > 0 and cfg.moe_capacity_factor < cfg.n_experts / max(
+        cfg.moe_top_k, 1
+    ):
+        model = TransformerLM(
+            dataclasses.replace(
+                cfg,
+                moe_capacity_factor=float(cfg.n_experts)
+                / max(cfg.moe_top_k, 1),
+            ),
+            mesh=model.mesh,
+        )
     if prompt.ndim == 1:
         prompt = prompt[None]
     cap = model.cfg.max_seq_len

@@ -53,6 +53,16 @@ class ModelConfig:
     # linear layers via the kv-state exclusive prefix (parallel/sequence.py),
     # softmax/swa layers via ring attention (parallel/ring.py)
     sequence_parallel: bool = False
+    # mixture-of-experts (models/moe.py): n_experts > 0 replaces the MLP of
+    # every moe_period-th block with a routed expert MLP; expert weights
+    # shard over the mesh's ep axis (parallel/sharding.py)
+    n_experts: int = 0
+    moe_period: int = 2  # every moe_period-th block is MoE
+    moe_top_k: int = 1  # 1 = Switch routing
+    moe_capacity_factor: float = 1.25
+    moe_group_size: int = 512  # GShard local-group length (0 = whole row)
+    moe_aux_weight: float = 1e-2  # load-balance loss weight
+    moe_zloss_weight: float = 1e-3  # router z-loss weight
     # classifier-only
     n_classes: int = 0  # >0 => LRA classifier head
 
@@ -69,6 +79,10 @@ class ModelConfig:
             h = int(self.d_model * 8 / 3)
             return max(128, (h + 127) // 128 * 128)
         return 4 * self.d_model
+
+    def moe_at(self, layer: int) -> bool:
+        """Does block ``layer`` (0-based) carry a routed-expert MLP?"""
+        return self.n_experts > 0 and (layer + 1) % self.moe_period == 0
 
     @property
     def resolved_layer_types(self) -> Tuple[str, ...]:
@@ -114,6 +128,22 @@ HYBRID_7B = ModelConfig(
     remat=True,
 )
 
+MOE_1B3_8E = ModelConfig(
+    # sparse sibling of LM_1B3: same base width, every other MLP routed over
+    # 8 experts (≈2.9B params total, ~1.3B active per token with top-1)
+    name="moe_1b3_8e",
+    vocab_size=32000,
+    d_model=2048,
+    n_layers=24,
+    n_heads=16,
+    max_seq_len=2048,
+    dtype="bfloat16",
+    remat=True,
+    n_experts=8,
+    moe_period=2,
+    moe_top_k=1,
+)
+
 LRA_LISTOPS_LINEAR = ModelConfig(
     name="lra_listops_linear",
     vocab_size=32,  # digits + operators + specials
@@ -156,6 +186,7 @@ CONFIGS = {
         TINY,
         LM_1B3,
         HYBRID_7B,
+        MOE_1B3_8E,
         LRA_LISTOPS_LINEAR,
         LRA_LISTOPS_SOFTMAX,
         LRA_TEXT_LINEAR,

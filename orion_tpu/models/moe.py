@@ -1,0 +1,223 @@
+"""Mixture-of-Experts MLP with expert parallelism over an ``ep`` mesh axis.
+
+TPU-first formulation (GShard/Switch style): routing is expressed as
+einsums against a dense dispatch/combine tensor, so the whole layer is
+static-shaped matmuls the MXU can tile — no gather/scatter, no dynamic
+shapes, no host round-trips. Expert FFN weights live STACKED on a leading
+expert axis (``[E, d, h]``) and shard over the mesh's ``ep`` axis
+(parallel/sharding.py); the dispatched activations are constrained to
+``P('ep', ...)`` so GSPMD materializes the token exchange as an
+all_to_all-class collective over ICI rather than replicating activations.
+
+Reference counterpart: none in BASELINE.json's config list (the reference
+checkout was never mounted — SURVEY.md §0); the driver's multi-chip
+contract names ``ep`` shardings explicitly, so expert parallelism is part
+of the framework's required parallelism vocabulary.
+
+Dispatch is GROUPED (GShard §3.2's local groups): tokens are split into
+groups of ``moe_group_size`` consecutive tokens of the same batch row, and
+capacity is enforced per group. This keeps the dispatch tensor at
+``N·E·C = N·cf·k·S`` elements instead of the flat formulation's
+``N²·cf·k/E`` (1.3 GB at the 1.3B config's 32k-token batches), and makes
+two properties structural rather than statistical:
+
+- causality: a token can only be evicted by EARLIER tokens of its own row
+  (in-group cumsum order), never by future tokens — appending tokens never
+  changes earlier positions' outputs;
+- batch independence: rows never compete for the same capacity slots.
+
+Recurrent decode matches the parallel forward exactly whenever the
+parallel pass drops nothing (capacity factor high enough for the routing
+pattern); a prompt token the parallel/prefill pass drops is still expert-
+processed by decode, so under drops the two paths differ by design —
+inference should raise ``moe_capacity_factor`` rather than mimic training
+-time drops.
+
+Routing semantics (jit-friendly, all static shapes):
+
+- router logits/probs computed in fp32;
+- top-k (k static, default 1 = Switch) chosen greedily slot by slot;
+- per-group-per-expert capacity ``C = ceil(cf·k·S/E)``; capacity positions
+  assigned token-major (see ``top_k_routing``) so eviction only ever comes
+  from the past, for every k; tokens beyond capacity are dropped (their
+  FFN branch contributes 0, the residual stream carries them unchanged);
+- combine weights renormalized over the chosen k experts;
+- load-balance aux loss (Switch: ``E·Σ_e f_e·P_e``) and router z-loss,
+  pre-weighted and sown into the ``"losses"`` collection — the trainer's
+  loss adds every leaf of that collection (training/trainer.py::lm_loss).
+
+Decode (``x`` rank-2, one token per row) uses one group with C = B so no
+token is ever dropped at decode time — exactness there beats the memory
+saving.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.models.configs import ModelConfig
+
+Array = jax.Array
+
+
+def _dtype(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
+
+
+def _expert_init(in_axis: int = -2):
+    """Per-expert lecun-normal over (in, out), expert dim as batch axis —
+    matches nn.Dense's default kernel init applied expert-wise."""
+    return nn.initializers.variance_scaling(
+        1.0, "fan_in", "truncated_normal", in_axis=in_axis, out_axis=-1,
+        batch_axis=(0,),
+    )
+
+
+def top_k_routing(probs: Array, k: int, capacity: int):
+    """probs [S, E] fp32 -> (dispatch [S, E, C] bool, combine [S, E, C]
+    fp32, assign [S, E] fp32) for ONE group.
+
+    Expert CHOICE is greedy top-k per token (slot s = argmax of the probs
+    with slots <s masked out). Capacity POSITIONS are assigned TOKEN-major:
+    all (token, slot) assignments are flattened in token order (t0s0, t0s1,
+    t1s0, ...) before the in-expert cumsum, so a token's position — and
+    therefore whether it is dropped — depends only on strictly earlier
+    tokens (all their slots) and its own earlier slots. That makes the
+    causality guarantee hold for every k, unlike GShard's slot-major
+    ordering where a FUTURE token's slot-0 pick can evict an earlier
+    token's slot-1 assignment; the price is that slot-0 traffic no longer
+    has priority over slot-1 traffic from earlier tokens. Combine weights
+    are the chosen experts' probs renormalized to sum to 1 over the k
+    choices.
+    """
+    n, e = probs.shape
+    masked = probs
+    onehots, gates = [], []
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)  # [S]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [S, E]
+        gates.append(jnp.sum(probs * onehot, axis=-1))  # [S]
+        # -1 (not *0): if every remaining prob underflowed to exactly 0,
+        # multiplicative masking would let argmax re-pick a chosen expert
+        # (index 0 of an all-zero row) and burn a capacity slot on it
+        masked = jnp.where(onehot > 0, -1.0, masked)
+        onehots.append(onehot)
+    oh = jnp.stack(onehots, axis=1)  # [S, k, E]
+    flat = oh.reshape(n * k, e)  # token-major (slot minor) order
+    pos = jnp.cumsum(flat, axis=0) - flat  # 0-based in-expert positions
+    pos_tok = jnp.sum(pos * flat, axis=-1).reshape(n, k)  # fp32 exact ints
+    keep = pos_tok < capacity  # [S, k]
+    disp_ke = (oh > 0) & keep[:, :, None]  # [S, k, E]
+    slot_oh = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity)  # [S, k, C]
+    disp_ksec = disp_ke[..., None] & (slot_oh[:, :, None, :] > 0)  # [S,k,E,C]
+    dispatch = disp_ksec.any(axis=1)  # [S, E, C]
+    gates_arr = jnp.stack(gates, axis=1)  # [S, k]
+    combine = jnp.sum(
+        disp_ksec.astype(jnp.float32) * gates_arr[:, :, None, None], axis=1
+    )
+    gate_total = gates_arr.sum(axis=1)
+    combine = combine / jnp.maximum(gate_total, 1e-9)[:, None, None]
+    assign_frac = oh.sum(axis=1) / k  # [S, E], each row sums to 1
+    return dispatch, combine, assign_frac
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for models.transformer.MLP on MoE layers."""
+
+    cfg: ModelConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        cfg = self.cfg
+        dt, pdt = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        e, k, h = cfg.n_experts, cfg.moe_top_k, cfg.resolved_mlp_hidden
+        d = x.shape[-1]
+        single = x.ndim == 2  # decode: [B, D]
+        if single:
+            xg = x[None]  # one group of B tokens
+            s = x.shape[0]
+            cap = s  # decode never drops
+        else:
+            t = x.shape[-2]
+            s = _group_size(t, cfg.moe_group_size)
+            xg = x.reshape(-1, s, d)  # [G, S, D]: consecutive same-row tokens
+            cap = min(s, max(k, math.ceil(cfg.moe_capacity_factor * k * s / e)))
+        g = xg.shape[0]
+
+        # -- routing (fp32) --------------------------------------------------
+        router = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, param_dtype=pdt, name="router"
+        )
+        logits = router(xg.astype(jnp.float32))  # [G, S, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine, assign = jax.vmap(
+            top_k_routing, in_axes=(0, None, None)
+        )(probs, k, cap)
+
+        # aux losses, pre-weighted; no-op unless the caller made "losses"
+        # mutable (training does; eval/decode don't). Guarded against init:
+        # otherwise model.init would return a junk "losses" collection that
+        # pollutes the param tree / TrainState.
+        if not self.is_initializing():
+            f = assign.mean(axis=(0, 1))  # fraction routed to each expert
+            p = probs.mean(axis=(0, 1))  # mean router prob mass per expert
+            aux = e * jnp.sum(f * p)
+            z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+            self.sow(
+                "losses", "moe_aux",
+                cfg.moe_aux_weight * aux + cfg.moe_zloss_weight * z,
+            )
+
+        # -- expert FFNs (stacked [E, ...], ep-sharded) ----------------------
+        if cfg.mlp == "swiglu":
+            wg = self.param("experts_gate", _expert_init(), (e, d, h), pdt)
+            wu = self.param("experts_up", _expert_init(), (e, d, h), pdt)
+        else:
+            wu = self.param("experts_up", _expert_init(), (e, d, h), pdt)
+        wdn = self.param("experts_down", _expert_init(), (e, h, d), pdt)
+
+        xe = jnp.einsum("gsd,gsec->gecd", xg.astype(dt), dispatch.astype(dt))
+        xe = self._ep_constraint(xe)
+        if cfg.mlp == "swiglu":
+            gt = jnp.einsum("gecd,edh->gech", xe, wg.astype(dt))
+            up = jnp.einsum("gecd,edh->gech", xe, wu.astype(dt))
+            mid = jax.nn.silu(gt) * up
+        else:
+            mid = jax.nn.gelu(jnp.einsum("gecd,edh->gech", xe, wu.astype(dt)))
+        ye = jnp.einsum("gech,ehd->gecd", mid, wdn.astype(dt))
+        ye = self._ep_constraint(ye)
+        y = jnp.einsum("gecd,gsec->gsd", ye, combine.astype(dt))
+        return y.reshape(x.shape).astype(dt)
+
+    def _ep_constraint(self, t: Array) -> Array:
+        """Pin the expert-major activation layout to the ep axis so GSPMD
+        emits one all_to_all-class exchange instead of replicating
+        [G,E,C,D]."""
+        if self.mesh is not None and self.mesh.shape.get("ep", 1) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if t.shape[1] % self.mesh.shape["ep"] == 0:
+                return jax.lax.with_sharding_constraint(
+                    t, NamedSharding(self.mesh, P(None, "ep", None, None))
+                )
+        return t
+
+
+def _group_size(t: int, target: int) -> int:
+    """Largest divisor of ``t`` not exceeding ``target`` (so groups tile the
+    sequence exactly and never span rows)."""
+    if target <= 0 or t <= target:
+        return t
+    for s in range(min(target, t), 0, -1):
+        if t % s == 0:
+            return s
+    return t
+
+
+__all__ = ["MoEMLP", "top_k_routing"]

@@ -312,13 +312,18 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-norm residual block: x + attn(norm(x)); x + mlp(norm(x))."""
+    """Pre-norm residual block: x + attn(norm(x)); x + mlp(norm(x)).
+
+    ``use_moe`` swaps the dense MLP for the routed-expert MoEMLP
+    (models/moe.py, ep-sharded); same name "mlp" so one sharding rule set
+    covers both layouts."""
 
     cfg: ModelConfig
     layer_type: str
     causal: bool = True
     mesh: Optional[Any] = None
     sp_local: bool = False
+    use_moe: bool = False
 
     def setup(self):
         self.norm1 = _norm(self.cfg, "norm1")
@@ -327,7 +332,12 @@ class Block(nn.Module):
             self.sp_local, name="attn"
         )
         self.norm2 = _norm(self.cfg, "norm2")
-        self.mlp = MLP(self.cfg, name="mlp")
+        if self.use_moe:
+            from orion_tpu.models.moe import MoEMLP
+
+            self.mlp = MoEMLP(self.cfg, mesh=self.mesh, name="mlp")
+        else:
+            self.mlp = MLP(self.cfg, name="mlp")
         self.drop = nn.Dropout(self.cfg.dropout)
 
     def __call__(self, x, mask=None, deterministic=True):
@@ -365,7 +375,10 @@ class TransformerLM(nn.Module):
                 Block, static_argnums=(3,), policy=REMAT_POLICIES[cfg.remat_policy]
             )
         self.blocks = [
-            block_cls(cfg, lt, True, self.mesh, name=f"block_{i}")
+            block_cls(
+                cfg, lt, True, self.mesh,
+                use_moe=cfg.moe_at(i), name=f"block_{i}",
+            )
             for i, lt in enumerate(cfg.resolved_layer_types)
         ]
         self.final_norm = _norm(cfg, "final_norm")

@@ -11,6 +11,7 @@ Axis meaning:
 - ``tp``   — tensor parallelism (heads / MLP hidden sharded)
 - ``sp``   — sequence/context parallelism (ring attention, SP linear attn)
 - ``pp``   — pipeline parallelism (GPipe stages over depth, parallel/pipeline.py)
+- ``ep``   — expert parallelism (routed MoE expert weights, models/moe.py)
 
 On multi-host (v4/v5 pods), lay dp/fsdp over DCN-connected slices and
 tp/sp within a slice so heavy collectives ride ICI —
@@ -27,7 +28,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXES = ("dp", "fsdp", "tp", "sp", "pp")
+AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,23 +40,24 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     pp: int = 1
+    ep: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        known = self.fsdp * self.tp * self.sp * self.pp
+        known = self.fsdp * self.tp * self.sp * self.pp * self.ep
         dp = self.dp
         if dp == -1:
             assert n_devices % known == 0, (n_devices, self)
             dp = n_devices // known
         total = dp * known
         assert total <= n_devices, (
-            f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp}x{self.pp} > "
-            f"{n_devices} devices"
+            f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp}x{self.pp}"
+            f"x{self.ep} > {n_devices} devices"
         )
-        return MeshConfig(dp, self.fsdp, self.tp, self.sp, self.pp)
+        return MeshConfig(dp, self.fsdp, self.tp, self.sp, self.pp, self.ep)
 
     @property
     def shape(self):
-        return (self.dp, self.fsdp, self.tp, self.sp, self.pp)
+        return (self.dp, self.fsdp, self.tp, self.sp, self.pp, self.ep)
 
 
 def make_mesh(
@@ -69,13 +71,15 @@ def make_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     cfg = (cfg or MeshConfig()).resolve(len(devices))
-    n = cfg.dp * cfg.fsdp * cfg.tp * cfg.sp * cfg.pp
+    n = cfg.dp * cfg.fsdp * cfg.tp * cfg.sp * cfg.pp * cfg.ep
     devices = devices[:n]  # explicit sub-mesh (e.g. single-device tests)
     if dcn_dp > 1:
         assert cfg.dp % dcn_dp == 0, (cfg, dcn_dp)
-        per_slice = (cfg.dp // dcn_dp, cfg.fsdp, cfg.tp, cfg.sp, cfg.pp)
+        per_slice = (
+            cfg.dp // dcn_dp, cfg.fsdp, cfg.tp, cfg.sp, cfg.pp, cfg.ep
+        )
         dev_array = mesh_utils.create_hybrid_device_mesh(
-            per_slice, (dcn_dp, 1, 1, 1, 1), devices=devices
+            per_slice, (dcn_dp, 1, 1, 1, 1, 1), devices=devices
         )
     else:
         dev_array = np.asarray(devices).reshape(cfg.shape)

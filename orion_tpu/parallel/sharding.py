@@ -26,6 +26,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # ordered (regex over 'path/to/param', spec) rules — first match wins
 _RULES = (
+    # MoE (models/moe.py): stacked expert FFNs [E, in, out] shard the expert
+    # dim over ep, the matmul dims over fsdp/tp like their dense twins
+    (r"experts_(gate|up)$", P("ep", "fsdp", "tp")),
+    (r"experts_down$", P("ep", "tp", "fsdp")),
+    (r"router/kernel$", P("fsdp", None)),
     (r"(wq|wk|wv|gate|up|phi_proj)/kernel$", P("fsdp", "tp")),
     (r"(wo|down)/kernel$", P("tp", "fsdp")),
     (r"lm_head_kernel$", P("fsdp", "tp")),
@@ -69,7 +74,9 @@ def param_shardings(abstract_params: Any, mesh: Mesh) -> Any:
         spec = spec_for_path(path)
         dims = []
         for i, ax in enumerate(spec):
-            if ax is None or i >= leaf.ndim:
+            # axes absent from this mesh (e.g. a bare ("pp",) test mesh
+            # sharding a param whose rule names "ep") fall back to replicated
+            if ax is None or i >= leaf.ndim or ax not in mesh.shape:
                 dims.append(None)
                 continue
             if leaf.shape[i] % mesh.shape[ax] == 0:

@@ -98,6 +98,8 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline stages (depth-homogeneous models)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel axis (MoE configs, model.n_experts>0)")
     p.add_argument("--distributed", action="store_true", help="multi-host init")
     p.add_argument(
         "--set", action="append", default=[], metavar="KEY=VALUE",
@@ -125,7 +127,7 @@ def main(argv=None) -> int:
         seed=args.seed,
         ckpt_dir=args.ckpt_dir,
         mesh=MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp,
-                        pp=args.pp),
+                        pp=args.pp, ep=args.ep),
     )
     if args.config_json:
         cfg = apply_overrides(cfg, load_json_overrides(args.config_json))

@@ -159,14 +159,19 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
 
 
 def lm_loss(model: TransformerLM, params, batch: Array, dropout_rng=None):
-    """batch [B, T+1] -> mean next-token cross entropy (fp32)."""
+    """batch [B, T+1] -> mean next-token cross entropy (fp32), plus any
+    auxiliary losses modules sowed into the "losses" collection (MoE
+    load-balance + z-loss, models/moe.py — already weighted there)."""
     x, y = batch[:, :-1], batch[:, 1:]
     kwargs = {}
     if dropout_rng is not None:
         kwargs = {"rngs": {"dropout": dropout_rng}, "deterministic": False}
-    logits = model.apply(params, x, **kwargs)
+    logits, variables = model.apply(params, x, mutable="losses", **kwargs)
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
-    return losses.mean()
+    loss = losses.mean()
+    for leaf in jax.tree.leaves(variables.get("losses", {})):
+        loss = loss + leaf
+    return loss
 
 
 class Trainer:
@@ -198,6 +203,11 @@ class Trainer:
         # axis and the state stores block params STACKED on a leading layer
         # axis sharded over pp (parallel/pipeline_lm.py)
         self.pp = self.mesh.shape.get("pp", 1)
+        if self.pp > 1 and cfg.model.n_experts > 0:
+            raise NotImplementedError(
+                "MoE layers under pipeline parallelism are not supported yet "
+                "(the GPipe loss path doesn't thread the aux-loss collection)"
+            )
         if self.pp > 1:
             from orion_tpu.parallel.pipeline_lm import stage_group
 

@@ -1,0 +1,347 @@
+"""MoE + expert parallelism tests (models/moe.py, ep mesh axis).
+
+Reference counterpart: none in BASELINE.json's config list (reference
+checkout never mounted — SURVEY.md §0); ep shardings are part of the
+driver's multi-chip contract. Test strategy mirrors the repo-wide pattern:
+exact small-scale invariants + virtual-mesh parity vs single device.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.models.configs import ModelConfig
+from orion_tpu.models.moe import MoEMLP, top_k_routing
+from orion_tpu.parallel.mesh import MeshConfig
+
+
+def _probs(n, e, seed=0):
+    return jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(seed), (n, e)), axis=-1
+    )
+
+
+class TestRouting:
+    def test_no_drops_at_full_capacity(self):
+        p = _probs(32, 4)
+        disp, comb, assign = top_k_routing(p, 2, capacity=32)
+        # every token keeps both slots; combine weights renormalize to 1
+        np.testing.assert_allclose(np.asarray(comb.sum((1, 2))), 1.0, atol=1e-5)
+        assert int(disp.sum()) == 32 * 2
+        np.testing.assert_allclose(np.asarray(assign.sum(-1)), 1.0, atol=1e-6)
+
+    def test_capacity_drops_excess_tokens(self):
+        # all tokens prefer expert 0 -> only `cap` survive
+        p = jnp.tile(jnp.asarray([[0.9, 0.1]]), (16, 1))
+        disp, comb, _ = top_k_routing(p, 1, capacity=4)
+        assert int(disp[:, 0].sum()) == 4
+        # dropped tokens have zero combine weight (residual passes through)
+        assert float(comb.sum((1, 2)).min()) == 0.0
+
+    def test_slots_unique_per_expert(self):
+        """No two tokens share an (expert, capacity-slot) cell."""
+        disp, _, _ = top_k_routing(_probs(64, 4, seed=3), 2, capacity=40)
+        per_cell = np.asarray(disp.sum(0))  # [E, C]
+        assert per_cell.max() <= 1
+
+    def test_underflowed_probs_never_redispatch(self):
+        """k=2 with softmax mass underflowed to exactly 0 on all non-top
+        experts: slot 2 must not re-pick the slot-1 expert (or burn a
+        capacity slot on a gate-0 duplicate)."""
+        logits = jnp.zeros((4, 4)).at[:, 2].set(200.0)  # softmax -> exact onehot
+        p = jax.nn.softmax(logits, axis=-1)
+        assert float(p[0].min()) == 0.0
+        disp, comb, _ = top_k_routing(p, 2, capacity=8)
+        # expert 2 holds each token exactly once (no double-dispatch)
+        assert int(disp[:, 2].sum()) == 4
+        per_tok = np.asarray(disp.sum((1, 2)))
+        assert per_tok.max() == 2  # one real + one (distinct) zero-gate slot
+        chosen = np.asarray(disp.any(-1))
+        assert not (chosen.sum(-1) == 1).any()  # slot-2 expert != slot-1's
+
+    def test_top1_picks_argmax(self):
+        p = _probs(16, 4, seed=5)
+        disp, _, _ = top_k_routing(p, 1, capacity=16)
+        chosen = np.asarray(disp.any(-1)).argmax(-1)
+        np.testing.assert_array_equal(chosen, np.asarray(p.argmax(-1)))
+
+
+class TestMoEMLP:
+    def test_single_expert_equals_dense_ffn(self):
+        """E=1, top-1: routing is the identity — the layer must match the
+        plain SwiGLU FFN built from expert 0's weights exactly."""
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=1, moe_top_k=1,
+            moe_capacity_factor=1.0, dtype="float32",
+        )
+        m = MoEMLP(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+        p = m.init(jax.random.PRNGKey(1), x)
+        y = m.apply(p, x)
+        w = p["params"]
+        ref = (
+            jax.nn.silu(x @ w["experts_gate"][0]) * (x @ w["experts_up"][0])
+        ) @ w["experts_down"][0]
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+    def test_init_has_no_losses_collection(self):
+        cfg = ModelConfig(name="t", d_model=16, n_experts=4, dtype="float32")
+        m = MoEMLP(cfg)
+        x = jnp.zeros((2, 4, 16))
+        p = m.init(jax.random.PRNGKey(0), x)
+        assert set(p.keys()) == {"params"}
+
+    def test_aux_loss_sown_once_and_finite(self):
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=4, moe_top_k=2, dtype="float32"
+        )
+        m = MoEMLP(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+        p = m.init(jax.random.PRNGKey(1), x)
+        _, v = m.apply(p, x, mutable="losses")
+        (aux,) = v["losses"]["moe_aux"]
+        assert np.isfinite(float(aux)) and float(aux) > 0
+
+    def test_router_gets_gradient(self):
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=4, moe_top_k=2, dtype="float32"
+        )
+        m = MoEMLP(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+        p = m.init(jax.random.PRNGKey(1), x)
+
+        def loss(p):
+            out, v = m.apply(p, x, mutable="losses")
+            return (out**2).mean() + sum(jax.tree.leaves(v["losses"]))
+
+        g = jax.grad(loss)(p)["params"]
+        assert float(jnp.abs(g["router"]["kernel"]).max()) > 0
+        assert float(jnp.abs(g["experts_gate"]).max()) > 0
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_causal_under_drops(self, k):
+        """Grouped dispatch + token-major positions make causality
+        structural for every k: with an aggressive capacity (many drops),
+        changing FUTURE tokens must not change any past position's output.
+        (k=2 is the case GShard's slot-major ordering would break: a future
+        token's slot-0 pick evicting an earlier token's slot-1.)"""
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=2, moe_top_k=k,
+            moe_capacity_factor=0.25, moe_group_size=8, dtype="float32",
+        )
+        m = MoEMLP(cfg)
+        p = m.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 16)))
+        for seed in range(8):  # several routing patterns
+            x = jax.random.normal(jax.random.PRNGKey(seed), (2, 16, 16))
+            y = m.apply(p, x)
+            x2 = x.at[:, 12:].set(
+                jax.random.normal(jax.random.PRNGKey(100 + seed), (2, 4, 16))
+            )
+            y2 = m.apply(p, x2)
+            np.testing.assert_allclose(
+                np.asarray(y[:, :12]), np.asarray(y2[:, :12]), atol=1e-6
+            )
+
+    def test_batch_rows_independent_under_drops(self):
+        """Groups never span rows: row 0's routing can't evict row 1's
+        tokens even when capacity is tight."""
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=2, moe_top_k=1,
+            moe_capacity_factor=0.25, moe_group_size=0, dtype="float32",
+        )
+        m = MoEMLP(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16))
+        p = m.init(jax.random.PRNGKey(1), x)
+        y = m.apply(p, x)
+        x2 = x.at[0].set(jax.random.normal(jax.random.PRNGKey(7), (16, 16)))
+        y2 = m.apply(p, x2)
+        np.testing.assert_allclose(np.asarray(y[1]), np.asarray(y2[1]), atol=1e-6)
+
+    def test_group_size_divides(self):
+        from orion_tpu.models.moe import _group_size
+
+        assert _group_size(2048, 512) == 512
+        assert _group_size(100, 512) == 100
+        assert _group_size(96, 50) == 48
+        assert _group_size(7, 4) == 1  # prime: degenerates to singletons
+
+    def test_decode_rank2_never_drops(self):
+        """Decode input [B, D] uses capacity = B: even if every row routes
+        to one expert, none is dropped."""
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=8, moe_top_k=1,
+            moe_capacity_factor=0.01, dtype="float32",
+        )
+        m = MoEMLP(cfg)
+        x = jnp.tile(jax.random.normal(jax.random.PRNGKey(0), (1, 16)), (4, 1))
+        p = m.init(jax.random.PRNGKey(1), x)
+        y = m.apply(p, x)
+        assert np.isfinite(np.asarray(y)).all()
+        # identical rows route identically -> identical outputs (no drops)
+        np.testing.assert_allclose(np.asarray(y[0]), np.asarray(y[3]), atol=1e-6)
+
+
+def _moe_model(**kw):
+    base = dict(
+        name="moe_test", vocab_size=64, d_model=32, n_layers=4, n_heads=2,
+        max_seq_len=64, dtype="float32", backend="xla",
+        n_experts=4, moe_period=2, moe_top_k=1, moe_capacity_factor=4.0,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+class TestMoETraining:
+    def test_trainer_step_and_loss_includes_aux(self):
+        from orion_tpu.training.data import SyntheticDataset
+        from orion_tpu.training.trainer import TrainConfig, Trainer, lm_loss
+
+        model = _moe_model()
+        cfg = TrainConfig(
+            model=model, steps=2, batch_size=8, seq_len=16, lr=1e-3,
+            warmup_steps=1, mesh=MeshConfig(dp=1), log_every=100,
+        )
+        tr = Trainer(cfg)
+        batch = jnp.asarray(SyntheticDataset(64, 16).batch(0, 0, 8))
+        m1 = tr.step(batch)
+        assert np.isfinite(float(m1["loss"]))
+        # aux loss really reaches the total: lm_loss > plain CE
+        x, y = batch[:, :-1], batch[:, 1:]
+        import optax
+
+        logits = tr.model.apply(tr.state.params, x)
+        # state advanced one step; re-eval on current params for both sides
+        total = lm_loss(tr.model, tr.state.params, batch)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        assert float(total) > float(ce)
+
+    @pytest.mark.parametrize(
+        "mesh_cfg",
+        [
+            MeshConfig(dp=2, fsdp=1, tp=1, sp=1, ep=4),
+            MeshConfig(dp=2, fsdp=1, tp=2, sp=1, ep=2),
+        ],
+        ids=["dp2ep4", "dp2tp2ep2"],
+    )
+    def test_trainer_parity_across_ep_meshes(self, mesh_cfg):
+        """Train step on an ep-sharded mesh == single device (GSPMD inserts
+        the expert all_to_all; the math must not change)."""
+        from orion_tpu.training.data import SyntheticDataset
+        from orion_tpu.training.trainer import TrainConfig, Trainer
+
+        model = _moe_model()
+        mk = lambda m: TrainConfig(  # noqa: E731
+            model=model, steps=2, batch_size=8, seq_len=16, lr=1e-3,
+            warmup_steps=1, mesh=m, log_every=100,
+        )
+        batch = jnp.asarray(SyntheticDataset(64, 16).batch(0, 0, 8))
+        t_ref = Trainer(mk(MeshConfig(dp=1)))
+        t_ep = Trainer(mk(mesh_cfg))
+        m_ref = t_ref.step(batch)
+        m_ep = t_ep.step(batch)
+        np.testing.assert_allclose(
+            float(m_ep["loss"]), float(m_ref["loss"]), atol=1e-5, rtol=1e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
+            ),
+            t_ep.state.params,
+            t_ref.state.params,
+        )
+        # the expert stack is genuinely sharded over ep
+        spec = t_ep.state_shardings.params["params"]["block_1"]["mlp"][
+            "experts_gate"
+        ].spec
+        assert spec[0] == "ep", spec
+
+    def test_moe_overfits_synthetic(self):
+        """The routed model still learns (loss drops >2x in 60 steps on a
+        repeated batch) — routing doesn't break optimization."""
+        from orion_tpu.training.data import SyntheticDataset
+        from orion_tpu.training.trainer import TrainConfig, Trainer
+
+        model = _moe_model(n_layers=2)
+        cfg = TrainConfig(
+            model=model, steps=60, batch_size=8, seq_len=16, lr=3e-3,
+            warmup_steps=5, mesh=MeshConfig(dp=1), log_every=100,
+        )
+        tr = Trainer(cfg)
+        batch = jnp.asarray(SyntheticDataset(64, 16).batch(0, 0, 8))
+        first = float(tr.step(batch)["loss"])
+        for _ in range(59):
+            last = tr.step(batch)
+        assert float(last["loss"]) < first / 2, (first, float(last["loss"]))
+
+    def test_pp_plus_moe_raises(self):
+        from orion_tpu.training.trainer import TrainConfig, Trainer
+
+        model = _moe_model()
+        cfg = TrainConfig(
+            model=model, steps=1, batch_size=8, seq_len=16,
+            mesh=MeshConfig(dp=1, pp=2),
+        )
+        with pytest.raises(NotImplementedError):
+            Trainer(cfg)
+
+
+class TestMoEDecode:
+    def test_greedy_decode_matches_parallel_argmax(self):
+        """The decisive decode invariant, on a hybrid MoE model: recurrent
+        decode through MoE blocks == parallel forward argmax. Capacity
+        factor is high so the parallel path drops nothing either."""
+        from orion_tpu.generate import SampleConfig, generate
+
+        cfg = _moe_model(
+            n_layers=4, layer_types=("linear", "softmax", "linear", "swa"),
+            window=8, moe_capacity_factor=8.0,
+        )
+        from orion_tpu.models.transformer import TransformerLM
+
+        model = TransformerLM(cfg)
+        rng = jax.random.PRNGKey(0)
+        prompt = jax.random.randint(rng, (2, 12), 0, cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(1), prompt)
+
+        n_new = 6
+        out = generate(
+            model, params, prompt, max_new_tokens=n_new,
+            sample=SampleConfig(temperature=0.0),
+        )
+        assert out.shape == (2, n_new)
+        # teacher-forced parallel re-derivation of each generated token
+        seq = prompt
+        for i in range(n_new):
+            logits = model.apply(params, seq)
+            want = jnp.argmax(logits[:, -1], axis=-1)
+            np.testing.assert_array_equal(np.asarray(want), np.asarray(out[:, i]))
+            seq = jnp.concatenate([seq, want[:, None]], axis=1)
+
+    def test_generate_auto_bumps_capacity_for_serving(self):
+        """A model trained with a dropping capacity factor is served in the
+        no-drop regime: generate()'s output must match the parallel argmax
+        of the capacity-raised model (and params are shared unchanged)."""
+        import dataclasses
+
+        from orion_tpu.generate import SampleConfig, generate
+        from orion_tpu.models.transformer import TransformerLM
+
+        cfg = _moe_model(n_layers=2, moe_capacity_factor=1.0)
+        model = TransformerLM(cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(3), prompt)
+        out = generate(
+            model, params, prompt, max_new_tokens=4,
+            sample=SampleConfig(temperature=0.0),
+        )
+        nodrop = TransformerLM(
+            dataclasses.replace(cfg, moe_capacity_factor=float(cfg.n_experts))
+        )
+        seq = prompt
+        for i in range(4):
+            want = jnp.argmax(nodrop.apply(params, seq)[:, -1], axis=-1)
+            np.testing.assert_array_equal(np.asarray(want), np.asarray(out[:, i]))
+            seq = jnp.concatenate([seq, want[:, None]], axis=1)
