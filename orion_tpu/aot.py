@@ -139,6 +139,7 @@ def main(argv=None) -> int:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1)
     p.add_argument("--optimizer", default="adamw")
     p.add_argument("--lower-only", action="store_true",
                    help="skip XLA compilation (faster; no memory analysis)")
@@ -168,7 +169,7 @@ def main(argv=None) -> int:
         seq_len=seq_len,
         optimizer=args.optimizer,
         mesh=MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp,
-                        pp=args.pp),
+                        pp=args.pp, ep=args.ep),
     )
     report = plan(cfg, compile_step=not args.lower_only)
     print(json.dumps(report))

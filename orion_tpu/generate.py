@@ -248,12 +248,21 @@ def main(argv=None) -> int:
     p.add_argument("--fsdp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="ModelConfig override, e.g. --set n_experts=8 (must match how "
+        "the checkpoint was trained)",
+    )
     args = p.parse_args(argv)
     for ax in ("dp", "fsdp", "tp", "sp"):
         if getattr(args, ax) < 1:
             p.error(f"--{ax} must be >= 1")
 
     cfg = get_config(args.config)
+    if args.set:
+        from orion_tpu.utils.config import apply_overrides, parse_set_overrides
+
+        cfg = apply_overrides(cfg, parse_set_overrides(args.set))
     eos_token = -1
     if args.tokenizer:
         from orion_tpu.utils.bpe import BPETokenizer

@@ -130,7 +130,10 @@ HYBRID_7B = ModelConfig(
 
 MOE_1B3_8E = ModelConfig(
     # sparse sibling of LM_1B3: same base width, every other MLP routed over
-    # 8 experts (≈2.9B params total, ~1.3B active per token with top-1)
+    # 8 experts (4.125B params total, 1.284B active per token with top-1).
+    # Pod-scale: does NOT fit one 16GB chip — shard experts over ep
+    # (16.5GB fp32 weights alone); single-chip validation is the AOT
+    # planning path (orion_tpu/aot.py), like hybrid_7b.
     name="moe_1b3_8e",
     vocab_size=32000,
     d_model=2048,
@@ -142,6 +145,13 @@ MOE_1B3_8E = ModelConfig(
     n_experts=8,
     moe_period=2,
     moe_top_k=1,
+)
+
+MOE_1B3_4E = dataclasses.replace(
+    # chip-scale sparse config (1.893B total, same 1.284B active/token):
+    # every 4th MLP routed over 4 experts — what bench.py --moe measures
+    # on the single 16GB chip
+    MOE_1B3_8E, name="moe_1b3_4e", n_experts=4, moe_period=4,
 )
 
 LRA_LISTOPS_LINEAR = ModelConfig(
@@ -187,6 +197,7 @@ CONFIGS = {
         LM_1B3,
         HYBRID_7B,
         MOE_1B3_8E,
+        MOE_1B3_4E,
         LRA_LISTOPS_LINEAR,
         LRA_LISTOPS_SOFTMAX,
         LRA_TEXT_LINEAR,

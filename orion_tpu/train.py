@@ -131,10 +131,9 @@ def main(argv=None) -> int:
     )
     if args.config_json:
         cfg = apply_overrides(cfg, load_json_overrides(args.config_json))
-    overrides = {}
-    for kv in args.set:
-        k, _, v = kv.partition("=")
-        overrides[k] = v
+    from orion_tpu.utils.config import parse_set_overrides
+
+    overrides = parse_set_overrides(args.set)
     if overrides:
         cfg = apply_overrides(cfg, overrides)
     if cfg.seq_len >= cfg.model.max_seq_len:

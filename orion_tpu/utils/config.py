@@ -69,8 +69,24 @@ def load_json_overrides(path: str) -> Dict[str, Any]:
         return json.load(f)
 
 
+def parse_set_overrides(pairs) -> Dict[str, Any]:
+    """['k=v', ...] (the CLIs' repeated --set flag) -> override mapping."""
+    overrides: Dict[str, Any] = {}
+    for kv in pairs:
+        k, sep, v = kv.partition("=")
+        if not sep or not k:
+            raise ValueError(f"--set expects KEY=VALUE, got {kv!r}")
+        overrides[k] = v
+    return overrides
+
+
 def config_to_dict(cfg: Any) -> Dict[str, Any]:
     return dataclasses.asdict(cfg)
 
 
-__all__ = ["apply_overrides", "load_json_overrides", "config_to_dict"]
+__all__ = [
+    "apply_overrides",
+    "load_json_overrides",
+    "parse_set_overrides",
+    "config_to_dict",
+]

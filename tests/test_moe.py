@@ -258,6 +258,28 @@ class TestMoETraining:
         ].spec
         assert spec[0] == "ep", spec
 
+    def test_moe_composes_with_sequence_parallel(self):
+        """MoE layers under sp: activations enter the MLP token-sharded
+        over sp and expert weights are ep-sharded; GSPMD must reshard
+        through the group reshape without changing the math."""
+        from orion_tpu.training.data import SyntheticDataset
+        from orion_tpu.training.trainer import TrainConfig, Trainer
+
+        model = _moe_model(
+            layer_types=("linear", "softmax", "linear", "swa"), window=8,
+            sequence_parallel=True, moe_group_size=8,
+        )
+        mk = lambda m: TrainConfig(  # noqa: E731
+            model=model, steps=2, batch_size=8, seq_len=32, lr=1e-3,
+            warmup_steps=1, mesh=m, log_every=100,
+        )
+        batch = jnp.asarray(SyntheticDataset(64, 32).batch(0, 0, 8))
+        m_ref = Trainer(mk(MeshConfig(dp=1))).step(batch)
+        m_sp = Trainer(mk(MeshConfig(dp=2, sp=2, ep=2))).step(batch)
+        np.testing.assert_allclose(
+            float(m_sp["loss"]), float(m_ref["loss"]), atol=1e-5, rtol=1e-5
+        )
+
     def test_moe_overfits_synthetic(self):
         """The routed model still learns (loss drops >2x in 60 steps on a
         repeated batch) — routing doesn't break optimization."""
@@ -319,6 +341,42 @@ class TestMoEDecode:
             want = jnp.argmax(logits[:, -1], axis=-1)
             np.testing.assert_array_equal(np.asarray(want), np.asarray(out[:, i]))
             seq = jnp.concatenate([seq, want[:, None]], axis=1)
+
+    def test_moe_checkpoint_serves_via_cli(self, tmp_path, capsys):
+        """Train-then-serve roundtrip for an MoE model through the CLI:
+        checkpoint save, load_params, capacity auto-bump, decode, print."""
+        from orion_tpu.generate import main
+        from orion_tpu.training.checkpoint import Checkpointer
+        from orion_tpu.training.data import SyntheticDataset
+        from orion_tpu.training.trainer import TrainConfig, Trainer
+
+        from orion_tpu.models.configs import get_config
+
+        model = get_config(
+            "tiny", n_experts=4, moe_period=2, backend="xla",
+        )
+        cfg = TrainConfig(
+            model=model, steps=2, batch_size=2, seq_len=32,
+            lr=1e-3, warmup_steps=1, log_every=100,
+            ckpt_dir=str(tmp_path / "ck"), ckpt_every=2, mesh=MeshConfig(dp=1),
+        )
+        trainer = Trainer(cfg)
+        ds = SyntheticDataset(model.vocab_size, cfg.seq_len)
+        ckpt = Checkpointer(cfg.ckpt_dir, save_every=2, async_save=False)
+        for step in (1, 2):
+            trainer.step(jnp.asarray(ds.batch(0, step, 2)))
+            ckpt.maybe_save(step, trainer.state)
+        ckpt.close()
+
+        rc = main([
+            "--config", "tiny", "--ckpt-dir", cfg.ckpt_dir,
+            "--prompt", "ab", "--max-new-tokens", "4", "--temperature", "0.0",
+            "--set", "n_experts=4", "--set", "moe_period=2",
+            "--set", "backend=xla",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.startswith("ab") and len(out.strip()) >= 2
 
     def test_generate_auto_bumps_capacity_for_serving(self):
         """A model trained with a dropping capacity factor is served in the
