@@ -36,7 +36,9 @@ class LRAClassifier(nn.Module):
             "cls", nn.initializers.normal(0.02), (cfg.d_model,), pdt
         )
         self.blocks = [
-            Block(cfg, lt, causal=False, name=f"block_{i}")
+            Block(
+                cfg, lt, causal=False, use_moe=cfg.moe_at(i), name=f"block_{i}"
+            )
             for i, lt in enumerate(cfg.resolved_layer_types)
         ]
         self.final_norm = _norm(cfg, "final_norm")

@@ -137,6 +137,9 @@ class MoEMLP(nn.Module):
         cfg = self.cfg
         dt, pdt = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
         e, k, h = cfg.n_experts, cfg.moe_top_k, cfg.resolved_mlp_hidden
+        # k > E would silently re-pick masked experts (argmax over an
+        # all -1 row) and leak combine weight — fail loudly instead
+        assert 1 <= k <= e, f"moe_top_k={k} must be in [1, n_experts={e}]"
         d = x.shape[-1]
         single = x.ndim == 2  # decode: [B, D]
         if single:

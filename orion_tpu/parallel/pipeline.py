@@ -54,25 +54,42 @@ def unstack_params(stacked: Any, n: int) -> list:
 
 
 def _stage_apply(
-    layer_fn: Callable, stage_params: Any, x: Array, rng: Any = None
-) -> Array:
+    layer_fn: Callable,
+    stage_params: Any,
+    x: Array,
+    rng: Any = None,
+    with_aux: bool = False,
+):
     """Run this device's stack of layers_per_stage layers sequentially.
     stage_params leaves: [layers_per_stage, ...]. With ``rng``, layer_fn is
-    called as layer_fn(params, h, key) with a key folded per layer slot."""
+    called as layer_fn(params, h, key) with a key folded per layer slot.
+    With ``with_aux``, layer_fn returns (h, aux_scalar) and the summed aux
+    is returned alongside the output: (out, aux)."""
     n = jax.tree.leaves(stage_params)[0].shape[0]
 
-    if rng is None:
-        def body(h, layer_params):
-            return layer_fn(layer_params, h), None
+    def call(layer_params, h, key):
+        if rng is None:
+            r = layer_fn(layer_params, h)
+        else:
+            r = layer_fn(layer_params, h, key)
+        return r if with_aux else (r, jnp.zeros((), jnp.float32))
 
-        out, _ = lax.scan(body, x, stage_params)
-    else:
-        def body(h, inp):
-            layer_params, slot = inp
-            return layer_fn(layer_params, h, jax.random.fold_in(rng, slot)), None
+    def body(carry, inp):
+        h, aux = carry
+        layer_params, slot = inp
+        key = None if rng is None else jax.random.fold_in(rng, slot)
+        h, a = call(layer_params, h, key)
+        return (h, aux + a), None
 
-        out, _ = lax.scan(body, x, (stage_params, jnp.arange(n)))
-    return out
+    # the aux carry must have the same varying-manual-axes type as the aux
+    # the body produces (derived from x, which is pp-varying inside the
+    # pipeline shard_map); multiplying by a zero slice of x inherits that
+    # type in shard_map context and is a no-op outside it
+    aux0 = jnp.zeros((), jnp.float32) + 0.0 * x.reshape(-1)[0].astype(
+        jnp.float32
+    )
+    (out, aux), _ = lax.scan(body, (x, aux0), (stage_params, jnp.arange(n)))
+    return (out, aux) if with_aux else out
 
 
 def pipeline_apply(
@@ -86,7 +103,8 @@ def pipeline_apply(
     rng: Any = None,
     extra_manual_axes: tuple = (),
     x_spec: Any = None,
-) -> Array:
+    with_aux: bool = False,
+):
     """Apply L stacked layers to ``x`` [B, ...] as a pp-stage pipeline.
 
     ``stacked_params``: every leaf [L, ...] with L % pp == 0; leading axis
@@ -111,10 +129,19 @@ def pipeline_apply(
     composition, parallel/pipeline_lm.py). ``x_spec`` places x w.r.t. the
     manual axes (e.g. P(None, 'sp', None) to hand the body sp-local token
     shards).
+
+    ``with_aux``: layer_fn returns (h, aux_scalar) — MoE aux losses
+    (models/moe.py). Returns (out, aux) where aux is the per-layer sum,
+    averaged over microbatches (each layer's sown value is a mean over
+    the tokens it saw, so the microbatch average matches the non-pp
+    full-batch scale; for the nonlinear load-balance term this is the
+    mean of per-microbatch stats — exactly equal to non-pp at n_micro=1,
+    statistically equivalent otherwise) and, when sp is manual, averaged
+    over sp shards.
     """
     pp = mesh.shape[axis]
     if pp == 1 and not extra_manual_axes:
-        return _stage_apply(layer_fn, stacked_params, x, rng)
+        return _stage_apply(layer_fn, stacked_params, x, rng, with_aux)
     b = x.shape[0]
     assert b % n_micro == 0, (b, n_micro)
     leaves = jax.tree.leaves(stacked_params)
@@ -139,9 +166,14 @@ def pipeline_apply(
         n_steps = n_micro + pp - 1
         zeros = jnp.zeros_like(micro[0])
         out0 = jnp.zeros_like(micro)
+        aux0 = jnp.zeros((), jnp.float32)
+        if hasattr(lax, "pcast"):
+            aux0 = lax.pcast(aux0, (axis,) + tuple(extra_manual_axes), to="varying")
+        else:
+            aux0 = lax.pvary(aux0, (axis,) + tuple(extra_manual_axes))
 
         def step(carry, s):
-            buf, outs = carry
+            buf, outs, aux_tot = carry
             # stage 0 injects microbatch s from the source; others take the
             # rotated buffer (their left neighbor's last output)
             m_idx = jnp.clip(s, 0, n_micro - 1)
@@ -159,7 +191,13 @@ def pipeline_apply(
                 # along the sharded dim with 1/|axis| the intended entropy
                 for ax in extra_manual_axes:
                     step_rng = jax.random.fold_in(step_rng, lax.axis_index(ax))
-            h_out = _stage_apply(layer_fn, params_local, h_in, step_rng)
+            if with_aux:
+                h_out, aux_s = _stage_apply(
+                    layer_fn, params_local, h_in, step_rng, True
+                )
+                aux_tot = aux_tot + jnp.where(active, aux_s, 0.0)
+            else:
+                h_out = _stage_apply(layer_fn, params_local, h_in, step_rng)
             h_out = jnp.where(active, h_out, zeros)
             # last stage banks its finished microbatch (s - (pp-1))
             o_idx = jnp.clip(s - (pp - 1), 0, n_micro - 1)
@@ -172,13 +210,23 @@ def pipeline_apply(
             nxt = lax.ppermute(
                 h_out, axis, [(j, (j + 1) % pp) for j in range(pp)]
             )
-            return (nxt, outs), None
+            return (nxt, outs, aux_tot), None
 
-        (_, outs), _ = lax.scan(step, (zeros, out0), jnp.arange(n_steps))
+        (_, outs, aux_tot), _ = lax.scan(
+            step, (zeros, out0, aux0), jnp.arange(n_steps)
+        )
         # every stage ran the scan; only the last stage's banked outputs are
         # real — broadcast them back over pp so out_specs can be replicated
         outs = lax.psum(jnp.where(i == pp - 1, outs, jnp.zeros_like(outs)), axis)
-        return outs.reshape(b, *x_all.shape[1:])
+        out = outs.reshape(b, *x_all.shape[1:])
+        if not with_aux:
+            return out
+        # stages hold disjoint layers: sum over pp; each layer sowed once
+        # per microbatch: average; sp shards each saw local tokens: average
+        aux = lax.psum(aux_tot, axis) / n_micro
+        for ax in extra_manual_axes:
+            aux = lax.pmean(aux, ax)
+        return out, aux
 
     pspec = jax.tree.map(lambda _: P(axis), stacked_params)
     xs = P() if x_spec is None else x_spec
@@ -186,7 +234,7 @@ def pipeline_apply(
         local,
         mesh=mesh,
         in_specs=(pspec, xs),
-        out_specs=xs,
+        out_specs=(xs, P()) if with_aux else xs,
         # partial-manual: pp (and any extra axes the body's collectives
         # need, e.g. sp) are manual; dp/fsdp/tp stay automatic so this
         # composes with GSPMD batch/tensor sharding in the trainer

@@ -44,15 +44,18 @@ Array = jax.Array
 
 
 def stage_group(cfg) -> int:
-    """Smallest period g such that the layer-type pattern repeats with
-    period g and g divides n_layers. Blocks are stacked in GROUPS of g —
-    a group's param structure is identical across depth even for hybrid
-    patterns (e.g. the 7B's swa,swa,swa,linear × 8 has g=4), which is what
-    lets heterogeneous models pipeline. Homogeneous models get g=1."""
-    lts = cfg.resolved_layer_types
-    n = len(lts)
+    """Smallest period g such that the BLOCK-STRUCTURE pattern — layer type
+    AND MoE-vs-dense MLP — repeats with period g and g divides n_layers.
+    Blocks are stacked in GROUPS of g — a group's param structure is then
+    identical across depth even for heterogeneous patterns (e.g. the 7B's
+    swa,swa,swa,linear × 8 has g=4; an every-other-layer MoE has g=2),
+    which is what lets such models pipeline. Homogeneous models get g=1."""
+    sig = [
+        (lt, cfg.moe_at(i)) for i, lt in enumerate(cfg.resolved_layer_types)
+    ]
+    n = len(sig)
     for g in range(1, n):
-        if n % g == 0 and all(lts[i] == lts[i % g] for i in range(n)):
+        if n % g == 0 and all(sig[i] == sig[i % g] for i in range(n)):
             return g
     return n  # aperiodic pattern: one group of all layers (pp=1 only)
 
@@ -108,13 +111,16 @@ def pp_lm_logits(
     n_micro: int,
     axis: str = "pp",
     dropout_rng: Any = None,
-) -> Array:
+    return_aux: bool = False,
+):
     """tokens [B, T] -> logits [B, T, V], blocks executed as a pp pipeline.
 
     Matches ``model.apply(params, tokens)`` exactly (same submodules, same
     dtypes); only the block loop is restructured. ``dropout_rng`` enables
     dropout (statistically equivalent to the non-pp forward: per-microbatch
-    masks — see pipeline_apply).
+    masks — see pipeline_apply). ``return_aux`` returns (logits, aux) where
+    aux is the microbatch-averaged sum of the blocks' sown "losses"
+    collection (MoE load-balance/z losses, models/moe.py).
     """
     cfg = model.cfg
     assert model.mesh is None or model.mesh is mesh, (
@@ -137,25 +143,40 @@ def pp_lm_logits(
             tokens.shape, dict(mesh.shape)
         )
     blocks = [
-        Block(cfg, cfg.resolved_layer_types[j], True, None, sp_on)
+        Block(
+            cfg, cfg.resolved_layer_types[j], True, None, sp_on,
+            use_moe=cfg.moe_at(j),
+        )
         for j in range(g)
     ]
 
-    if dropout_rng is None:
-        def layer_fn(group_params, h):
-            for j, blk in enumerate(blocks):
-                h = blk.apply({"params": group_params[f"sub_{j}"]}, h)
-            return h
-    else:
-        def layer_fn(group_params, h, key):
-            for j, blk in enumerate(blocks):
-                h = blk.apply(
-                    {"params": group_params[f"sub_{j}"]},
-                    h,
-                    deterministic=False,
-                    rngs={"dropout": jax.random.fold_in(key, j)},
-                )
-            return h
+    def apply_block(j, group_params, h, key):
+        kwargs = {}
+        if key is not None:
+            kwargs = {
+                "deterministic": False,
+                "rngs": {"dropout": jax.random.fold_in(key, j)},
+            }
+        if not return_aux:
+            return blocks[j].apply(
+                {"params": group_params[f"sub_{j}"]}, h, **kwargs
+            ), 0.0
+        h, v = blocks[j].apply(
+            {"params": group_params[f"sub_{j}"]}, h, mutable="losses", **kwargs
+        )
+        aux = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree.leaves(v.get("losses", {})):
+            aux = aux + leaf
+        return h, aux
+
+    # pipeline_apply calls layer_fn with (params, h) or (params, h, key)
+    # depending on whether rng is passed — one body serves both arities
+    def layer_fn(group_params, h, key=None):
+        aux = jnp.zeros((), jnp.float32)
+        for j in range(g):
+            h, a = apply_block(j, group_params, h, key)
+            aux = aux + a
+        return (h, aux) if return_aux else h
 
     if cfg.remat:
         from orion_tpu.models.transformer import REMAT_POLICIES
@@ -170,7 +191,7 @@ def pp_lm_logits(
 
     from jax.sharding import PartitionSpec as P
 
-    x = pipeline_apply(
+    out = pipeline_apply(
         stacked, x, layer_fn, mesh, n_micro=n_micro, axis=axis,
         rng=dropout_rng,
         # pp×sp: sp must be manual in the SAME shard_map (nested manual
@@ -178,8 +199,11 @@ def pp_lm_logits(
         # bodies on sp-local token shards
         extra_manual_axes=("sp",) if sp_on else (),
         x_spec=P(None, "sp", None) if sp_on else None,
+        with_aux=return_aux,
     )
-    return model.apply(params, x, method=lambda m, h: m._head(h))
+    x, aux = out if return_aux else (out, None)
+    logits = model.apply(params, x, method=lambda m, h: m._head(h))
+    return (logits, aux) if return_aux else logits
 
 
 def pp_lm_loss(
@@ -192,15 +216,19 @@ def pp_lm_loss(
     axis: str = "pp",
     dropout_rng: Any = None,
 ) -> Array:
-    """batch [B, T+1] -> mean next-token cross entropy under the pipeline."""
+    """batch [B, T+1] -> mean next-token cross entropy under the pipeline
+    (+ microbatch-averaged MoE aux losses for MoE models)."""
     import optax
 
     x, y = batch[:, :-1], batch[:, 1:]
-    logits = pp_lm_logits(
+    moe = model.cfg.n_experts > 0
+    out = pp_lm_logits(
         model, params, x, mesh, n_micro=n_micro, axis=axis,
-        dropout_rng=dropout_rng,
+        dropout_rng=dropout_rng, return_aux=moe,
     )
-    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    logits, aux = out if moe else (out, None)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    return loss + aux if moe else loss
 
 
 __all__ = [

@@ -221,10 +221,17 @@ def train_lra(cfg: LRATrainConfig, logger: Optional[MetricsLogger] = None):
         kwargs = (
             {"rngs": {"dropout": rng}, "deterministic": False} if use_drop else {}
         )
-        logits = model.apply(params, toks, mask, **kwargs)
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        logits, variables = model.apply(
+            params, toks, mask, mutable="losses", **kwargs
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        # MoE aux losses (models/moe.py), pre-weighted; empty for dense
+        for leaf in jax.tree.leaves(variables.get("losses", {})):
+            loss = loss + leaf
         acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
-        return loss.mean(), acc.mean()
+        return loss, acc.mean()
 
     @partial(jax.jit, donate_argnums=(0,))
     def step_fn(state, toks, labels, mask):

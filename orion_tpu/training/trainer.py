@@ -203,11 +203,6 @@ class Trainer:
         # axis and the state stores block params STACKED on a leading layer
         # axis sharded over pp (parallel/pipeline_lm.py)
         self.pp = self.mesh.shape.get("pp", 1)
-        if self.pp > 1 and cfg.model.n_experts > 0:
-            raise NotImplementedError(
-                "MoE layers under pipeline parallelism are not supported yet "
-                "(the GPipe loss path doesn't thread the aux-loss collection)"
-            )
         if self.pp > 1:
             from orion_tpu.parallel.pipeline_lm import stage_group
 
