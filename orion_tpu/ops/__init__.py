@@ -9,7 +9,7 @@ Layout:
 - ``dispatch``: backend="xla"|"pallas"|"auto" selection.
 """
 
-from orion_tpu.ops.feature_maps import make_feature_map
+from orion_tpu.ops.feature_maps import make_feature_map, register_feature_map
 from orion_tpu.ops.linear_attention import (
     causal_dot_product_eager,
     causal_dot_product_chunked,
@@ -34,6 +34,7 @@ __all__ = [
     "apply_rotary_at",
     "rotary_freqs",
     "make_feature_map",
+    "register_feature_map",
     "causal_dot_product",
     "causal_dot_product_eager",
     "causal_dot_product_chunked",

@@ -130,6 +130,35 @@ _SIMPLE = {
 }
 
 
+def register_feature_map(name: str, fn=None):
+    """Register a custom elementwise feature map under ``name`` so any
+    config can select it (``ModelConfig(feature_map=name)``) — the
+    user-extensibility hook the reference exposes through its attention/
+    feature-map registry (BASELINE.json names the feature-map projections
+    as a pluggable kernel family; reference checkout never mounted —
+    SURVEY.md §0). Usable directly or as a decorator:
+
+        @register_feature_map("softplus")
+        def _softplus(x):
+            return jax.nn.softplus(x)
+
+    The map must be positive-valued for causal linear attention (the
+    normalizer q·z must stay > 0) and elementwise over the feature dim.
+    Re-registering a built-in name raises; pick a new name.
+    """
+
+    def install(f):
+        # "favor" and "learnable" are special-cased inside the Attention
+        # module (random features / learned projection) — registering them
+        # here would be silently shadowed there, so reserve the names too
+        if name in _SIMPLE or name in ("favor", "learnable"):
+            raise ValueError(f"feature map {name!r} already registered")
+        _SIMPLE[name] = f
+        return f
+
+    return install if fn is None else install(fn)
+
+
 def make_feature_map(
     name: str,
     *,
@@ -137,7 +166,8 @@ def make_feature_map(
     dim: Optional[int] = None,
     num_features: Optional[int] = None,
 ) -> FeatureMap:
-    """Build a feature map by name. ``favor`` requires ``key`` and ``dim``."""
+    """Build a feature map by name (built-in or registered via
+    ``register_feature_map``). ``favor`` requires ``key`` and ``dim``."""
     if name == "favor":
         if key is None or dim is None:
             raise ValueError("favor feature map requires key= and dim=")
@@ -147,4 +177,9 @@ def make_feature_map(
     return FeatureMap(name=name, fn=_SIMPLE[name])
 
 
-__all__ = ["FeatureMap", "make_feature_map", "favor_features"]
+__all__ = [
+    "FeatureMap",
+    "make_feature_map",
+    "register_feature_map",
+    "favor_features",
+]

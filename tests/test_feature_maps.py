@@ -47,3 +47,36 @@ def test_unknown_name_raises():
         make_feature_map("nope")
     with pytest.raises(ValueError):
         make_feature_map("favor")  # missing key/dim
+
+
+def test_register_custom_feature_map():
+    """User-extensibility hook: a registered map is selectable from any
+    ModelConfig and runs through the full model (the reference's pluggable
+    feature-map family)."""
+    from orion_tpu.models.configs import ModelConfig
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.ops import register_feature_map
+
+    @register_feature_map("softplus_test")
+    def _softplus(x):
+        return jax.nn.softplus(x)
+
+    fm = make_feature_map("softplus_test")
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8))
+    np.testing.assert_allclose(
+        np.asarray(fm(x)), np.asarray(jax.nn.softplus(x)), atol=1e-6
+    )
+
+    cfg = ModelConfig(
+        name="custom_fm", vocab_size=32, d_model=16, n_layers=2, n_heads=2,
+        max_seq_len=16, dtype="float32", backend="xla",
+        feature_map="softplus_test",
+    )
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 32)
+    params = model.init(jax.random.PRNGKey(2), toks)
+    logits = model.apply(params, toks)
+    assert np.isfinite(np.asarray(logits)).all()
+
+    with pytest.raises(ValueError):
+        register_feature_map("elu1", lambda x: x)  # built-ins protected
