@@ -1,11 +1,19 @@
-"""Named-axis collective wrappers (SURVEY.md P8).
+"""Cross-shard combine primitives used inside ``shard_map`` bodies (SURVEY.md P8).
 
-The vocabulary the reference speaks in NCCL (allreduce / allgather /
-reduce_scatter / sendrecv; BASELINE.json NCCL DP wrapper — reference
-checkout never mounted, SURVEY.md §0), expressed as XLA collectives over
-mesh axes. These are used *inside* ``shard_map`` bodies (sequence.py,
-ring.py); the GSPMD training path never calls them directly — jit inserts
-its own from shardings.
+The reference speaks NCCL (allreduce / allgather / reduce_scatter /
+sendrecv; BASELINE.json NCCL DP wrapper — reference checkout never
+mounted, SURVEY.md §0). Here that vocabulary splits in two:
+
+- the GSPMD training path never calls collectives at all — jit inserts
+  psum/all_gather/reduce_scatter/all_to_all from the shardings
+  (parallel/sharding.py, models/moe.py), which is the point of the design;
+- manual ``shard_map`` bodies (sequence.py, ring.py, pipeline.py) call
+  ``jax.lax`` collectives directly, plus the two composite primitives
+  below that encode actual cross-shard logic.
+
+Earlier revisions also re-exported one-line ``lax.*`` delegates here; they
+had no callers and no added semantics, so they were removed — this module
+keeps only primitives that earn their name.
 """
 
 from __future__ import annotations
@@ -19,30 +27,10 @@ Array = jax.Array
 Axis = Union[str, tuple]
 
 
-def psum(x: Array, axis: Axis) -> Array:
-    return lax.psum(x, axis)
-
-
-def pmean(x: Array, axis: Axis) -> Array:
-    return lax.pmean(x, axis)
-
-
-def pmax(x: Array, axis: Axis) -> Array:
-    return lax.pmax(x, axis)
-
-
-def all_gather(x: Array, axis: Axis, *, gather_axis: int = 0, tiled: bool = False) -> Array:
-    """Gather shards along ``gather_axis`` (new leading dim if tiled=False)."""
-    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
-
-
-def reduce_scatter(x: Array, axis: Axis, *, scatter_axis: int = 0) -> Array:
-    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
-
-
 def ppermute_shift(x: Array, axis: str, shift: int = 1) -> Array:
-    """Rotate shards around the ring: device i -> device (i+shift) % n.
-    The neighbor-to-neighbor hop ring attention runs on (ring.py)."""
+    """Rotate shards around the ring: device i -> device (i+shift) % n —
+    the neighbor-to-neighbor ICI hop ring attention (ring.py) runs on.
+    (pipeline.py's stage rotation builds the same perm inline.)"""
     n = lax.axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
@@ -64,27 +52,4 @@ def exclusive_prefix_sum(x_local: Array, axis: Axis) -> Array:
     return jnp.sum(gathered * mask, axis=0)
 
 
-def all_to_all(x: Array, axis: str, *, split_axis: int, concat_axis: int) -> Array:
-    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
-
-
-def axis_index(axis: str) -> Array:
-    return lax.axis_index(axis)
-
-
-def axis_size(axis: str) -> int:
-    return lax.axis_size(axis)
-
-
-__all__ = [
-    "psum",
-    "pmean",
-    "pmax",
-    "all_gather",
-    "reduce_scatter",
-    "ppermute_shift",
-    "exclusive_prefix_sum",
-    "all_to_all",
-    "axis_index",
-    "axis_size",
-]
+__all__ = ["ppermute_shift", "exclusive_prefix_sum"]

@@ -371,6 +371,51 @@ class TestMoETraining:
         assert float(last["loss"]) < first / 1.5, (first, float(last["loss"]))
 
 
+def test_moe_checkpoint_restores_across_ep_meshes(tmp_path):
+    """Expert resharding on restore: an MoE checkpoint written on a dp-only
+    mesh restores onto an ep-sharded mesh (orbax reshards the stacked
+    expert weights onto ep) and continues to the same final params within
+    fp tolerance."""
+    from orion_tpu.training.checkpoint import Checkpointer
+    from orion_tpu.training.data import SyntheticDataset
+    from orion_tpu.training.trainer import TrainConfig, Trainer
+
+    model = _moe_model()
+    mk = lambda m: TrainConfig(  # noqa: E731
+        model=model, steps=4, batch_size=8, seq_len=16, lr=1e-3,
+        warmup_steps=1, mesh=m, log_every=100,
+        ckpt_dir=str(tmp_path / "ck"), ckpt_every=2,
+    )
+    ds = SyntheticDataset(model.vocab_size, 16)
+    it = lambda start=0: iter(  # noqa: E731
+        jnp.asarray(ds.batch(0, s, 8)) for s in range(start + 1, 100)
+    )
+
+    tr_a = Trainer(mk(MeshConfig(dp=1)))
+    ck_a = Checkpointer(str(tmp_path / "ck"), save_every=2, async_save=False)
+    tr_a.train(it(), ckpt=ck_a)  # saves at steps 2 and 4
+    final_a = jax.tree.map(np.asarray, tr_a.state.params)
+    ck_a.close()
+
+    tr_b = Trainer(mk(MeshConfig(dp=2, ep=2)))
+    ck_b = Checkpointer(str(tmp_path / "ck"), save_every=10_000, async_save=False)
+    start = tr_b.restore(ck_b, step=2)
+    assert start == 2
+    spec = tr_b.state_shardings.params["params"]["block_1"]["mlp"][
+        "experts_gate"
+    ].spec
+    assert spec[0] == "ep", spec
+    tr_b.train(it(start))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            a, np.asarray(b), atol=2e-5, rtol=2e-5
+        ),
+        final_a,
+        tr_b.state.params,
+    )
+    ck_b.close()
+
+
 def test_classifier_honors_moe_config():
     """LRAClassifier builds MoE blocks from the same config fields as
     TransformerLM (and the aux loss is sown for train_lra's loss)."""
