@@ -123,8 +123,10 @@ def bench_train(
                 "n_active_params": n_active,
             }
         except Exception as e:  # OOM at this batch size -> halve
-            last_err = e
             msg = str(e)
+            # keep only the message: holding the exception would pin its
+            # traceback -> this frame's trainer/state -> device HBM
+            last_err = msg
             if (
                 "RESOURCE_EXHAUSTED" not in msg
                 and "Out of memory" not in msg
@@ -135,6 +137,18 @@ def bench_train(
                 f"batch {batch_size} failed ({msg.splitlines()[0][:100]}); halving",
                 file=sys.stderr,
             )
+            # the failed Trainer's sharded state would otherwise survive the
+            # iteration: Trainer <-> jitted-step reference cycle + jax's
+            # executable caches keep device buffers alive, and the next
+            # (smaller) attempt OOMs on the leftovers (seen at T=16k: b2
+            # fits alone but OOM'd after the b16/b8/b4 failures)
+            import gc
+
+            import jax
+
+            trainer = batch = m = None  # noqa: F841
+            gc.collect()
+            jax.clear_caches()
     raise RuntimeError(f"all batch sizes OOM'd: {last_err}")
 
 

@@ -11,8 +11,9 @@ the reference checkout itself was never mounted, see SURVEY.md §0):
 - softmax and sliding-window attention (flash-style Pallas kernels) for the
   LRA configs and the hybrid model family,
 - ``train`` / ``generate`` entrypoints,
-- data/fsdp/tensor/sequence parallelism over a `jax.sharding.Mesh` with XLA
-  collectives over ICI/DCN (replacing the reference's NCCL wrapper).
+- data/fsdp/tensor/sequence/pipeline/expert parallelism over a
+  `jax.sharding.Mesh` with XLA collectives over ICI/DCN (replacing the
+  reference's NCCL wrapper), including routed-expert (MoE) models.
 """
 
 __version__ = "0.1.0"
@@ -31,6 +32,12 @@ _LAZY = {
     "LRAClassifier": ("orion_tpu.models.classifier", "LRAClassifier"),
     "ModelConfig": ("orion_tpu.models.configs", "ModelConfig"),
     "get_config": ("orion_tpu.models.configs", "get_config"),
+    "MoEMLP": ("orion_tpu.models.moe", "MoEMLP"),
+    "MeshConfig": ("orion_tpu.parallel.mesh", "MeshConfig"),
+    "make_mesh": ("orion_tpu.parallel.mesh", "make_mesh"),
+    "register_feature_map": (
+        "orion_tpu.ops.feature_maps", "register_feature_map",
+    ),
 }
 
 

@@ -68,6 +68,15 @@ def _sds(shape, dtype, like: Array):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def _tri_mask(cdim: int, anti: bool = False):
+    """Boolean (C, C) in-chunk time mask: causal ``s <= t`` rows>=cols, or
+    anti-causal ``s >= t`` with ``anti=True``. One definition shared by all
+    five chunk kernels so the numerator recurrences can't drift apart."""
+    row = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 1)
+    return row <= col if anti else row >= col
+
+
 def _kernel(q_ref, k_ref, v_ref, s0_ref, out_ref, sf_ref, s_scr):
     c = pl.program_id(1)
 
@@ -85,10 +94,7 @@ def _kernel(q_ref, k_ref, v_ref, s0_ref, out_ref, sf_ref, s_scr):
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # (C, C) fp32
-    cdim = scores.shape[0]
-    row = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 1)
-    scores = jnp.where(row >= col, scores, 0.0)
+    scores = jnp.where(_tri_mask(scores.shape[0]), scores, 0.0)
 
     intra = jnp.dot(scores, vi.astype(jnp.float32), preferred_element_type=jnp.float32)
     inter = jnp.dot(
@@ -170,10 +176,7 @@ def _bwd_rev_kernel(q_ref, k_ref, v_ref, g_ref, rinit_ref, dk_ref, dv_ref, rfin_
         vi, gi, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # (C, C): v_t · g_s
-    cdim = svg.shape[0]
-    row = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 1)
-    anti = row <= col  # s >= t
+    anti = _tri_mask(svg.shape[0], anti=True)  # s >= t
     svg = jnp.where(anti, svg, 0.0)
     skq = jax.lax.dot_general(
         ki, qi, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -199,6 +202,186 @@ def _bwd_rev_kernel(q_ref, k_ref, v_ref, g_ref, rinit_ref, dk_ref, dv_ref, rfin_
         preferred_element_type=jnp.float32,
     )  # += sum_t g_t (x) q_t
     rfin_ref[0] = r_scr[:]
+
+
+def _bwd_dq_den_kernel(
+    g_ref, v_ref, k_ref, s0t_ref, gden_ref, z0_ref, dq_ref, s_scr, z_scr
+):
+    """Forward-walking fused dq for the NORMALIZED backward: the numerator
+    part (same math as ``_kernel`` on (g, v, k) with S0^T carried in) plus
+    the denominator part ``gden_t * (z0 + Σ_{s<=t} k_s)`` — the prefix-z
+    state rides the same pass instead of a separate XLA cumsum over
+    [BH, T, Dk] fp32 (measured: the two den cumsum passes were ~30% of
+    fused-backward wall time at long T). In-chunk prefix sums are a
+    lower-triangular matmul on the MXU."""
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _():
+        s_scr[:] = s0t_ref[0].astype(jnp.float32)  # (Dv, Dk)
+        z_scr[:] = z0_ref[0].astype(jnp.float32)  # (1, Dk)
+
+    gi = g_ref[0]  # (C, Dv)
+    vi = v_ref[0]  # (C, Dv)
+    ki = k_ref[0]  # (C, Dk)
+    gd = gden_ref[0].astype(jnp.float32)  # (C, 1)
+
+    scores = jax.lax.dot_general(
+        gi, vi, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (C, C): g_t · v_s
+    causal = _tri_mask(scores.shape[0]).astype(jnp.float32)  # s <= t
+    scores = scores * causal
+
+    kf = ki.astype(jnp.float32)
+    intra = jnp.dot(scores, kf, preferred_element_type=jnp.float32)
+    inter = jnp.dot(
+        gi.astype(jnp.float32), s_scr[:], preferred_element_type=jnp.float32
+    )
+    kcum = jnp.dot(causal, kf, preferred_element_type=jnp.float32)  # prefix-incl
+    dq_ref[0] = (intra + inter + gd * (z_scr[:] + kcum)).astype(dq_ref.dtype)
+
+    s_scr[:] = s_scr[:] + jax.lax.dot_general(
+        vi, ki, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # += Σ v_s (x) k_s
+    z_scr[:] = z_scr[:] + jnp.sum(kf, axis=0, keepdims=True)
+
+
+def _cdp_dq_den_flat(g, v, k, s0t, gden, z0, chunk, interpret):
+    """dq (numerator + denominator parts) on flat inputs, emitted directly
+    in ``g``'s dtype — nothing downstream adds to it."""
+    bh, t, dk = k.shape
+    dv = v.shape[-1]
+    nc = t // chunk
+
+    (dq,) = pl.pallas_call(
+        _bwd_dq_den_kernel,
+        grid=(bh, nc),
+        in_specs=[
+            pl.BlockSpec((1, chunk, dv), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dv), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dk), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dv, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, 1), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, chunk, dk), lambda b, c: (b, c, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[_sds((bh, t, dk), g.dtype, g)],
+        scratch_shapes=[
+            pltpu.VMEM((dv, dk), jnp.float32),
+            pltpu.VMEM((1, dk), jnp.float32),
+        ],
+        interpret=interpret,
+    )(g, v, k, s0t, gden, z0)
+    return dq
+
+
+def _bwd_rev_den_kernel(
+    q_ref, k_ref, v_ref, g_ref, gden_ref, rinit_ref, zr0_ref,
+    dk_ref, dv_ref, rfin_ref, zrfin_ref, r_scr, zr_scr,
+):
+    """``_bwd_rev_kernel`` plus the denominator's dk part fused in:
+
+        dk_den[t] = gzf + Σ_{s>=t} gden_s q_s
+
+    carried as a (1, Dk) suffix state over the last->first chunk walk
+    (zr0 = gzf, so the broadcast-to-every-t gzf term rides for free and
+    the final state IS dz0 = gzf + Σ_t gden_t q_t). dk/dv come out in the
+    input dtype — they are final, no downstream adds."""
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _():
+        r_scr[:] = rinit_ref[0].astype(jnp.float32)  # dSf^T
+        zr_scr[:] = zr0_ref[0].astype(jnp.float32)  # gzf (1, Dk)
+
+    qi = q_ref[0]  # (C, Dk)
+    ki = k_ref[0]
+    vi = v_ref[0]
+    gi = g_ref[0]  # (C, Dv)
+    gd = gden_ref[0].astype(jnp.float32)  # (C, 1)
+
+    svg = jax.lax.dot_general(
+        vi, gi, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (C, C): v_t · g_s
+    anti = _tri_mask(svg.shape[0], anti=True).astype(jnp.float32)  # s >= t
+    svg = svg * anti
+    skq = jax.lax.dot_general(
+        ki, qi, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * anti
+
+    gq = gd * qi.astype(jnp.float32)  # (C, Dk)
+    sufx = jnp.dot(anti, gq, preferred_element_type=jnp.float32)  # suffix-incl
+
+    dk_ref[0] = (
+        jnp.dot(svg, qi.astype(jnp.float32), preferred_element_type=jnp.float32)
+        + jnp.dot(vi.astype(jnp.float32), r_scr[:], preferred_element_type=jnp.float32)
+        + zr_scr[:]
+        + sufx
+    ).astype(dk_ref.dtype)
+    dv_ref[0] = (
+        jnp.dot(skq, gi.astype(jnp.float32), preferred_element_type=jnp.float32)
+        + jax.lax.dot_general(
+            ki.astype(jnp.float32), r_scr[:],
+            dimension_numbers=(((1,), (1,)), ((), ())),  # k_t @ R^T
+            preferred_element_type=jnp.float32,
+        )
+    ).astype(dv_ref.dtype)
+
+    r_scr[:] = r_scr[:] + jax.lax.dot_general(
+        gi, qi, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    zr_scr[:] = zr_scr[:] + jnp.sum(gq, axis=0, keepdims=True)
+    rfin_ref[0] = r_scr[:]
+    zrfin_ref[0] = zr_scr[:]
+
+
+def _cdp_rev_den_flat(q, k, v, g, gden, rinit, zr0, chunk, interpret):
+    """Fused (dk, dv, ds0, dz0) for the normalized backward. dk/dv in the
+    input dtypes (final values); ds0 [BH, Dk, Dv] and dz0 [BH, 1, Dk] fp32."""
+    bh, t, dk = q.shape
+    dv = v.shape[-1]
+    nc = t // chunk
+    rev = lambda b, c: (b, nc - 1 - c, 0)  # noqa: E731
+
+    dk_out, dv_out, rfin, zrfin = pl.pallas_call(
+        _bwd_rev_den_kernel,
+        grid=(bh, nc),
+        in_specs=[
+            pl.BlockSpec((1, chunk, dk), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dk), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dv), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dv), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, 1), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dv, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, chunk, dk), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk, dv), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dv, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, dk), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            _sds((bh, t, dk), k.dtype, q),
+            _sds((bh, t, dv), v.dtype, q),
+            _sds((bh, dv, dk), jnp.float32, q),
+            _sds((bh, 1, dk), jnp.float32, q),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((dv, dk), jnp.float32),
+            pltpu.VMEM((1, dk), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, gden, rinit, zr0)
+    ds0 = jnp.swapaxes(rfin, -1, -2)
+    return dk_out, dv_out, ds0, zrfin
 
 
 def _cdp_rev_flat(q, k, v, g, rinit, chunk, interpret):
@@ -336,10 +519,7 @@ def _kernel_norm(
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    cdim = scores.shape[0]
-    row = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (cdim, cdim), 1)
-    scores = jnp.where(row >= col, scores, 0.0)
+    scores = jnp.where(_tri_mask(scores.shape[0]), scores, 0.0)
 
     intra = jnp.dot(scores, vi.astype(jnp.float32), preferred_element_type=jnp.float32)
     inter = jnp.dot(qi.astype(jnp.float32), s_scr[:], preferred_element_type=jnp.float32)
@@ -419,42 +599,30 @@ def _lin_attn_fused_fwd(q, k, v, s0, z0, chunk, eps, interpret):
 def _fused_bwd_core(q, k, v, s0, z0, gnum, gden, gsf, gzf, chunk, interpret):
     """Shared backward for the fused pass given cotangents of the fp32
     numerator (gnum, already cast to q.dtype for the kernel), denominator
-    (gden [BH,T,1] fp32), and final states (gsf, gzf)."""
+    (gden [BH,T,1] fp32), and final states (gsf, gzf).
+
+    Two kernel passes, with the denominator backward FUSED into both (the
+    earlier formulation ran it as two XLA cumsums over [BH,T,Dk] fp32 plus
+    elementwise combines — pure HBM traffic):
+
+    - forward walk (_bwd_dq_den_kernel): dq = numerator part + gden·zcum,
+      the prefix-z carried in VMEM; emitted directly in q.dtype.
+    - reverse walk (_bwd_rev_den_kernel): dk (incl. suffix Σ gden·q and
+      the broadcast gzf, both riding a (1,Dk) carried state), dv, ds0;
+      the final suffix state IS dz0.
+    """
     gsf32 = gsf.astype(jnp.float32)
-
-    # numerator part: dq via the forward kernel on (gnum, v, k) with S0^T
-    # folded into its carried state; dk/dv/ds0 via one reverse-walking
-    # fused pass (no time-flip copies — see _bwd_rev_kernel)
-    s0t = jnp.swapaxes(s0.astype(jnp.float32), -1, -2)
-    dq, _ = _cdp_flat(gnum, v, k, s0t, chunk, interpret)
-    dq = dq.astype(jnp.float32)
-    rinit = jnp.swapaxes(gsf32, -1, -2)
-    dk, dv, ds0 = _cdp_rev_flat(q, k, v, gnum, rinit, chunk, interpret)
-
-    # denominator part: den[t] = q_t·z0 + Σ_{s<=t} q_t·k_s  (cheap XLA cumsums)
-    kf = k.astype(jnp.float32)
-    qf = q.astype(jnp.float32)
-    zcum = jnp.cumsum(kf, axis=-2) + z0.astype(jnp.float32)  # (BH,1,Dk) bcast
-    gq_den = gden * zcum
-    # suffix-inclusive cumsum without flips: Σ_{s>=t} x = total - Σ_{s<t} x
-    gqd = gden * qf
-    cs = jnp.cumsum(gqd, axis=-2)
-    gk_den = cs[..., -1:, :] - cs + gqd
-    gz0 = cs[..., -1:, :]  # Σ_t gden_t q_t  (BH, 1, Dk)
-
-    # final-z cotangent: zf = z0 + Σ_s k_s
     gzf32 = gzf.astype(jnp.float32)
-    dq_total = dq + gq_den
-    dk_total = dk + gk_den + gzf32
-    dz0 = gz0 + gzf32
+    gden32 = gden.astype(jnp.float32)
 
-    return (
-        dq_total.astype(q.dtype),
-        dk_total.astype(k.dtype),
-        dv.astype(v.dtype),
-        ds0,
-        dz0,
+    s0t = jnp.swapaxes(s0.astype(jnp.float32), -1, -2)
+    z032 = z0.astype(jnp.float32)
+    dq = _cdp_dq_den_flat(gnum, v, k, s0t, gden32, z032, chunk, interpret)
+    rinit = jnp.swapaxes(gsf32, -1, -2)
+    dk, dv, ds0, dz0 = _cdp_rev_den_flat(
+        q, k, v, gnum, gden32, rinit, gzf32, chunk, interpret
     )
+    return dq.astype(q.dtype), dk, dv, ds0, dz0
 
 
 def _lin_attn_fused_bwd(chunk, eps, interpret, res, cts):
@@ -555,8 +723,9 @@ def linear_attention_pallas_fused(
     out[t] = q_t·S_t / (q_t·z_t + eps) with S, z the kv-cumsum states;
     optionally seeded by ``initial_state=(S0 [..,Dk,Dv], z0 [..,Dk])`` and
     returning the final (S, z) — the prefill→decode handoff. Differentiable
-    through everything including the states (custom VJP: kernel passes for
-    the numerator, O(T·Dk) cumsums for the denominator)."""
+    through everything including the states (custom VJP: two kernel passes,
+    with the denominator backward fused in as carried (1, Dk) VMEM states —
+    see ``_fused_bwd_core``)."""
     qf, kf, vf, s0, z0, batch_shape, t, chunk = _prep_fused(q, k, v, chunk, initial_state)
     dk, dv = q.shape[-1], v.shape[-1]
 
