@@ -88,8 +88,11 @@ def bench_linear_attention(shapes=None, iters: int = 20) -> List[Dict]:
     return rows
 
 
-def bench_softmax_attention(shapes=None, iters: int = 20) -> List[Dict]:
-    """Causal softmax attention: Pallas flash vs XLA masked-dense."""
+def _bench_softmax_family(
+    op_name: str, window, shapes, iters: int
+) -> List[Dict]:
+    """Shared harness for the softmax-attention family: Pallas flash vs
+    XLA masked-dense, optionally windowed."""
     from orion_tpu.ops.softmax_attention import softmax_attention
 
     if shapes is None:
@@ -97,9 +100,16 @@ def bench_softmax_attention(shapes=None, iters: int = 20) -> List[Dict]:
     rows = []
     for b, h, t, d in shapes:
         q, k, v = _qkv(b, h, t, d, featurized=False)
-        row = {"op": "softmax_attention", "b": b, "h": h, "t": t, "d": d}
+        row = {"op": op_name, "b": b, "h": h, "t": t, "d": d}
+        if window is not None:
+            row["window"] = window
         for backend in ("xla", "pallas"):
-            fwd = jax.jit(partial(softmax_attention, causal=True, backend=backend))
+            fwd = jax.jit(
+                partial(
+                    softmax_attention, causal=True, window=window,
+                    backend=backend,
+                )
+            )
 
             def loss(q, k, v, _f=fwd):
                 return _f(q, k, v).astype(jnp.float32).sum()
@@ -123,8 +133,25 @@ def bench_softmax_attention(shapes=None, iters: int = 20) -> List[Dict]:
     return rows
 
 
+def bench_softmax_attention(shapes=None, iters: int = 20) -> List[Dict]:
+    """Causal softmax attention: Pallas flash vs XLA masked-dense."""
+    return _bench_softmax_family("softmax_attention", None, shapes, iters)
+
+
+def bench_swa_attention(shapes=None, window: int = 1024, iters: int = 20) -> List[Dict]:
+    """Sliding-window softmax (the 7B hybrid's dominant layer type,
+    BASELINE.json config #5): Pallas flash with structural tile skipping
+    vs XLA masked-dense. The flash path's cost is O(T·W); the dense path
+    is O(T²) regardless of the window."""
+    return _bench_softmax_family("swa_attention", window, shapes, iters)
+
+
 def run_all(iters: int = 20) -> List[Dict]:
-    return bench_linear_attention(iters=iters) + bench_softmax_attention(iters=iters)
+    return (
+        bench_linear_attention(iters=iters)
+        + bench_softmax_attention(iters=iters)
+        + bench_swa_attention(iters=iters)
+    )
 
 
 if __name__ == "__main__":
