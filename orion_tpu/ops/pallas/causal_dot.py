@@ -148,8 +148,11 @@ def _cdp_flat(
     return out, sf
 
 
-def _bwd_rev_kernel(q_ref, k_ref, v_ref, g_ref, rinit_ref, dk_ref, dv_ref, rfin_ref, r_scr):
-    """Reverse-walking fused backward: one pass emits dk AND dv.
+def _bwd_rev_core(
+    q_ref, k_ref, v_ref, g_ref, gden_ref, rinit_ref, zr0_ref,
+    dk_ref, dv_ref, rfin_ref, zrfin_ref, r_scr, zr_scr,
+):
+    """Reverse-walking fused backward body: one pass emits dk AND dv.
 
         dk[t] = v_t @ R_t,   dv[t] = k_t @ R_t^T,
         R_t   = dSf^T + sum_{s>=t} g_s (x) q_s   (Dv, Dk)
@@ -159,12 +162,25 @@ def _bwd_rev_kernel(q_ref, k_ref, v_ref, g_ref, rinit_ref, dk_ref, dv_ref, rfin_
     (the previous formulation spent 3 kernel passes + 6 jnp.flip HBM copies;
     measured 0.64-0.79x vs XLA on-chip — this pass + the dq pass replace it).
     dS0 = (final R)^T falls out for free.
+
+    With the denominator refs non-None (the normalized path), the dk part
+
+        dk_den[t] = gzf + Σ_{s>=t} gden_s q_s
+
+    rides as a second (1, Dk) suffix state over the same walk (zr0 = gzf,
+    so the broadcast-to-every-t gzf term comes for free and the final
+    state IS dz0 = gzf + Σ_t gden_t q_t). One body serves both kernels so
+    the numerator recurrence cannot drift between the normalized and
+    unnormalized backwards.
     """
+    with_den = gden_ref is not None
     c = pl.program_id(1)
 
     @pl.when(c == 0)
     def _():
         r_scr[:] = rinit_ref[0].astype(jnp.float32)  # dSf^T
+        if with_den:
+            zr_scr[:] = zr0_ref[0].astype(jnp.float32)  # gzf (1, Dk)
 
     qi = q_ref[0]  # (C, Dk)
     ki = k_ref[0]
@@ -177,17 +193,30 @@ def _bwd_rev_kernel(q_ref, k_ref, v_ref, g_ref, rinit_ref, dk_ref, dv_ref, rfin_
         preferred_element_type=jnp.float32,
     )  # (C, C): v_t · g_s
     anti = _tri_mask(svg.shape[0], anti=True)  # s >= t
+    # jnp.where (not a float-mask multiply): a non-finite masked-out entry
+    # must hard-zero, not turn into inf*0 = NaN — same style as _kernel
     svg = jnp.where(anti, svg, 0.0)
-    skq = jax.lax.dot_general(
-        ki, qi, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+    skq = jnp.where(
+        anti,
+        jax.lax.dot_general(
+            ki, qi, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ),
+        0.0,
     )  # (C, C): k_t · q_s
-    skq = jnp.where(anti, skq, 0.0)
 
-    dk_ref[0] = (
+    dk = (
         jnp.dot(svg, qi.astype(jnp.float32), preferred_element_type=jnp.float32)
         + jnp.dot(vi.astype(jnp.float32), r_scr[:], preferred_element_type=jnp.float32)
-    ).astype(dk_ref.dtype)
+    )
+    if with_den:
+        gd = gden_ref[0].astype(jnp.float32)  # (C, 1)
+        gq = gd * qi.astype(jnp.float32)  # (C, Dk)
+        sufx = jnp.dot(
+            anti.astype(jnp.float32), gq, preferred_element_type=jnp.float32
+        )
+        dk = dk + zr_scr[:] + sufx
+    dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = (
         jnp.dot(skq, gi.astype(jnp.float32), preferred_element_type=jnp.float32)
         + jax.lax.dot_general(
@@ -202,6 +231,17 @@ def _bwd_rev_kernel(q_ref, k_ref, v_ref, g_ref, rinit_ref, dk_ref, dv_ref, rfin_
         preferred_element_type=jnp.float32,
     )  # += sum_t g_t (x) q_t
     rfin_ref[0] = r_scr[:]
+    if with_den:
+        zr_scr[:] = zr_scr[:] + jnp.sum(gq, axis=0, keepdims=True)
+        zrfin_ref[0] = zr_scr[:]
+
+
+def _bwd_rev_kernel(q_ref, k_ref, v_ref, g_ref, rinit_ref, dk_ref, dv_ref, rfin_ref, r_scr):
+    """Unnormalized-path arity adapter over ``_bwd_rev_core``."""
+    _bwd_rev_core(
+        q_ref, k_ref, v_ref, g_ref, None, rinit_ref, None,
+        dk_ref, dv_ref, rfin_ref, None, r_scr, None,
+    )
 
 
 def _bwd_dq_den_kernel(
@@ -279,67 +319,9 @@ def _cdp_dq_den_flat(g, v, k, s0t, gden, z0, chunk, interpret):
     return dq
 
 
-def _bwd_rev_den_kernel(
-    q_ref, k_ref, v_ref, g_ref, gden_ref, rinit_ref, zr0_ref,
-    dk_ref, dv_ref, rfin_ref, zrfin_ref, r_scr, zr_scr,
-):
-    """``_bwd_rev_kernel`` plus the denominator's dk part fused in:
-
-        dk_den[t] = gzf + Σ_{s>=t} gden_s q_s
-
-    carried as a (1, Dk) suffix state over the last->first chunk walk
-    (zr0 = gzf, so the broadcast-to-every-t gzf term rides for free and
-    the final state IS dz0 = gzf + Σ_t gden_t q_t). dk/dv come out in the
-    input dtype — they are final, no downstream adds."""
-    c = pl.program_id(1)
-
-    @pl.when(c == 0)
-    def _():
-        r_scr[:] = rinit_ref[0].astype(jnp.float32)  # dSf^T
-        zr_scr[:] = zr0_ref[0].astype(jnp.float32)  # gzf (1, Dk)
-
-    qi = q_ref[0]  # (C, Dk)
-    ki = k_ref[0]
-    vi = v_ref[0]
-    gi = g_ref[0]  # (C, Dv)
-    gd = gden_ref[0].astype(jnp.float32)  # (C, 1)
-
-    svg = jax.lax.dot_general(
-        vi, gi, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (C, C): v_t · g_s
-    anti = _tri_mask(svg.shape[0], anti=True).astype(jnp.float32)  # s >= t
-    svg = svg * anti
-    skq = jax.lax.dot_general(
-        ki, qi, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * anti
-
-    gq = gd * qi.astype(jnp.float32)  # (C, Dk)
-    sufx = jnp.dot(anti, gq, preferred_element_type=jnp.float32)  # suffix-incl
-
-    dk_ref[0] = (
-        jnp.dot(svg, qi.astype(jnp.float32), preferred_element_type=jnp.float32)
-        + jnp.dot(vi.astype(jnp.float32), r_scr[:], preferred_element_type=jnp.float32)
-        + zr_scr[:]
-        + sufx
-    ).astype(dk_ref.dtype)
-    dv_ref[0] = (
-        jnp.dot(skq, gi.astype(jnp.float32), preferred_element_type=jnp.float32)
-        + jax.lax.dot_general(
-            ki.astype(jnp.float32), r_scr[:],
-            dimension_numbers=(((1,), (1,)), ((), ())),  # k_t @ R^T
-            preferred_element_type=jnp.float32,
-        )
-    ).astype(dv_ref.dtype)
-
-    r_scr[:] = r_scr[:] + jax.lax.dot_general(
-        gi, qi, dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    zr_scr[:] = zr_scr[:] + jnp.sum(gq, axis=0, keepdims=True)
-    rfin_ref[0] = r_scr[:]
-    zrfin_ref[0] = zr_scr[:]
+# normalized path: _bwd_rev_core's full signature IS the kernel (all den
+# refs live; dk/dv come out in the input dtype — they are final values)
+_bwd_rev_den_kernel = _bwd_rev_core
 
 
 def _cdp_rev_den_flat(q, k, v, g, gden, rinit, zr0, chunk, interpret):
