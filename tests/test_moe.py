@@ -529,3 +529,26 @@ class TestMoEDecode:
             want = jnp.argmax(nodrop.apply(params, seq)[:, -1], axis=-1)
             np.testing.assert_array_equal(np.asarray(want), np.asarray(out[:, i]))
             seq = jnp.concatenate([seq, want[:, None]], axis=1)
+
+
+def test_bench_active_param_accounting():
+    """bench.py's MFU denominator: expert stacks count only their routed
+    share (top_k/E); dense models are unchanged."""
+    import bench as bench_mod
+    from orion_tpu.training.trainer import TrainConfig, Trainer
+
+    cfg = TrainConfig(
+        model=_moe_model(n_layers=2), steps=1, batch_size=2, seq_len=8,
+        mesh=MeshConfig(dp=1),
+    )
+    tr = Trainer(cfg)
+    total = bench_mod._n_params(tr)
+    active = bench_mod._n_active_params(tr)
+    expert = sum(
+        x.size
+        for p, x in jax.tree_util.tree_leaves_with_path(tr.state.params)
+        if "experts_" in jax.tree_util.keystr(p)
+    )
+    k, e = cfg.model.moe_top_k, cfg.model.n_experts
+    assert active == total - expert + expert * k / e
+    assert 0 < active < total
