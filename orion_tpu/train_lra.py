@@ -302,9 +302,19 @@ def main(argv=None) -> int:
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-path", default=None)
+    p.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="ModelConfig override, e.g. --set feature_map=favor "
+        "(same syntax as the generate CLI; the train CLI's --set takes "
+        "dotted TrainConfig keys like model.feature_map instead)",
+    )
     args = p.parse_args(argv)
 
     model = get_config(args.config, max_seq_len=args.seq_len + 8)
+    if args.set:
+        from orion_tpu.utils.config import apply_overrides, parse_set_overrides
+
+        model = apply_overrides(model, parse_set_overrides(args.set))
     cfg = LRATrainConfig(
         model=model, task=args.task, steps=args.steps,
         batch_size=args.batch_size, seq_len=args.seq_len, lr=args.lr,

@@ -552,3 +552,29 @@ def test_bench_active_param_accounting():
     k, e = cfg.model.moe_top_k, cfg.model.n_experts
     assert active == total - expert + expert * k / e
     assert 0 < active < total
+
+
+@pytest.mark.parametrize(
+    "aux_w,tol", [(0.0, 2e-5), (1e-2, 5e-3)], ids=["exact_no_aux", "stat_default"]
+)
+def test_moe_grad_accumulation_parity(aux_w, tol):
+    """accum_steps=2 vs 1 on an MoE model: exact with the load-balance
+    term zeroed (CE + z-loss are linear in per-microbatch token stats);
+    only statistically equivalent with it on (same nonlinearity caveat as
+    GPipe microbatching)."""
+    import dataclasses as dc
+
+    from orion_tpu.training.data import SyntheticDataset
+    from orion_tpu.training.trainer import TrainConfig, Trainer
+
+    model = dc.replace(_moe_model(n_layers=2), moe_aux_weight=aux_w)
+    mk = lambda acc: TrainConfig(  # noqa: E731
+        model=model, steps=1, batch_size=8, seq_len=16, lr=1e-3,
+        warmup_steps=1, accum_steps=acc, mesh=MeshConfig(dp=1), log_every=100,
+    )
+    batch = jnp.asarray(SyntheticDataset(64, 16).batch(0, 0, 8))
+    m1 = Trainer(mk(1)).step(batch)
+    m2 = Trainer(mk(2)).step(batch)
+    np.testing.assert_allclose(
+        float(m2["loss"]), float(m1["loss"]), atol=tol, rtol=tol
+    )
