@@ -142,34 +142,44 @@ def bench_train(
             # executable caches keep device buffers alive, and the next
             # (smaller) attempt OOMs on the leftovers (seen at T=16k: b2
             # fits alone but OOM'd after the b16/b8/b4 failures)
-            import gc
-
-            import jax
-
             trainer = batch = m = None  # noqa: F841
-            gc.collect()
-            jax.clear_caches()
+            _free_device_memory()
     raise RuntimeError(f"all batch sizes OOM'd: {last_err}")
 
 
-def bench_decode(config: str = "tiny", n_tokens: int = 64,
-                 prompt_len: int = 16, batch_size: int = 1) -> float:
-    """p50 per-token latency (ms) of recurrent decode."""
+def _decode_model(config: str, prompt_len: int, n_tokens: int,
+                  quant: str = ""):
+    """(model, params) for decode benching; random-ish constant weights.
+    Weight VALUES don't affect decode latency (same dots either way), so a
+    constant fill is fine — parity of the quant path is tests/test_quant.py."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
-    from orion_tpu.generate import SampleConfig, generate
+    from orion_tpu.generate import quantize_for_decode
     from orion_tpu.models.configs import get_config
     from orion_tpu.models.transformer import TransformerLM
 
     cfg = get_config(config, max_seq_len=max(prompt_len + n_tokens + 8, 512))
     model = TransformerLM(cfg)
-    prompt = jnp.ones((batch_size, prompt_len), jnp.int32)
+    prompt = jnp.ones((1, prompt_len), jnp.int32)
     params = jax.eval_shape(model.init, jax.random.PRNGKey(0), prompt)
     params = jax.tree.map(
         lambda s: jnp.full(s.shape, 0.01, s.dtype), params
     )
+    if quant:
+        qmodel, qparams = quantize_for_decode(model, params)
+        return qmodel, qparams
+    return model, params
+
+
+def _decode_p50(model, params, prompt_len: int, n_tokens: int,
+                batch_size: int) -> float:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orion_tpu.generate import SampleConfig, generate
+
+    prompt = jnp.ones((batch_size, prompt_len), jnp.int32)
     sample = SampleConfig(temperature=0.0)
     np.asarray(generate(model, params, prompt, n_tokens, sample))  # compile
     times = []
@@ -180,6 +190,81 @@ def bench_decode(config: str = "tiny", n_tokens: int = 64,
     return sorted(times)[len(times) // 2]
 
 
+def bench_decode(config: str = "tiny", n_tokens: int = 64,
+                 prompt_len: int = 16, batch_size: int = 1,
+                 quant: str = "") -> float:
+    """p50 per-token latency (ms) of recurrent decode."""
+    model, params = _decode_model(config, prompt_len, n_tokens, quant)
+    return _decode_p50(model, params, prompt_len, n_tokens, batch_size)
+
+
+def _free_device_memory():
+    """Drop the previous family's params/executables before the next one —
+    jax's executable caches otherwise pin HBM across families (same leak
+    bench_train works around)."""
+    import gc
+
+    import jax
+
+    gc.collect()
+    jax.clear_caches()
+
+
+def decode_matrix(batches=(1, 4, 8, 16, 32), prompt_len: int = 512,
+                  n_tokens: int = 32) -> dict:
+    """VERDICT r2 #7: ONE process measures dense fp32, dense int8, and MoE
+    decode across batch sizes, so every cross-family ratio is same-run —
+    no more cross-run 'relay drift' footnotes. Families run sequentially
+    with an explicit free in between (16GB chip)."""
+    out = {"prompt_len": prompt_len, "n_tokens": n_tokens, "rows": {}}
+    fams = [
+        ("dense_fp32", "lm_1b3", ""),
+        ("dense_int8", "lm_1b3", "int8"),
+        ("moe4e_fp32", "moe_1b3_4e", ""),
+        ("moe4e_int8", "moe_1b3_4e", "int8"),
+    ]
+    for fam, config, quant in fams:
+        model = params = None
+        try:
+            model, params = _decode_model(config, prompt_len, n_tokens, quant)
+            row = {}
+            for b in batches:
+                try:
+                    row[f"b{b}"] = round(
+                        _decode_p50(model, params, prompt_len, n_tokens, b), 4
+                    )
+                    print(json.dumps({"decode": fam, f"b{b}": row[f"b{b}"]}),
+                          file=sys.stderr)
+                except Exception as e:
+                    row[f"b{b}"] = None
+                    print(f"{fam} b{b} failed: {e}"[:200], file=sys.stderr)
+            out["rows"][fam] = row
+        except Exception as e:
+            print(f"{fam} failed: {e}"[:200], file=sys.stderr)
+        finally:
+            model = params = None  # noqa: F841
+            _free_device_memory()
+    rows = out["rows"]
+
+    def ratio(a, b):
+        return (
+            round(a / b, 4) if isinstance(a, float) and isinstance(b, float)
+            else None
+        )
+
+    out["ratios"] = {}
+    for b in batches:
+        k = f"b{b}"
+        d, di = rows.get("dense_fp32", {}), rows.get("dense_int8", {})
+        m, mi = rows.get("moe4e_fp32", {}), rows.get("moe4e_int8", {})
+        out["ratios"][k] = {
+            "int8_vs_fp32_dense": ratio(di.get(k), d.get(k)),
+            "moe_vs_dense_fp32": ratio(m.get(k), d.get(k)),
+            "int8_vs_fp32_moe": ratio(mi.get(k), m.get(k)),
+        }
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("bench")
     ap.add_argument("--kernels", action="store_true",
@@ -188,9 +273,18 @@ def main(argv=None) -> int:
                     help="also bench the moe_1b3_4e chip-scale sparse config")
     ap.add_argument("--quick", action="store_true",
                     help="train bench only, fewer iters")
+    ap.add_argument("--decode-matrix", action="store_true",
+                    help="one-process dense/int8/MoE decode matrix across "
+                         "batch sizes (same-run ratios); skips the train bench")
     args = ap.parse_args(argv)
 
     _enable_compile_cache()
+
+    if args.decode_matrix:
+        mat = decode_matrix()
+        print(json.dumps({"decode_matrix": mat}))
+        return 0
+
     res = bench_train(iters=5 if args.quick else 10)
 
     if not args.quick:
@@ -198,6 +292,8 @@ def main(argv=None) -> int:
             ("decode_p50_ms_per_token_tiny", dict(config="tiny")),
             ("decode_p50_ms_per_token_lm1b3_b1_p512",
              dict(config="lm_1b3", prompt_len=512, n_tokens=32)),
+            ("decode_p50_ms_per_token_lm1b3_b1_p512_int8",
+             dict(config="lm_1b3", prompt_len=512, n_tokens=32, quant="int8")),
             ("decode_p50_ms_per_token_lm1b3_b8_p512",
              dict(config="lm_1b3", prompt_len=512, n_tokens=32, batch_size=8)),
         ]:

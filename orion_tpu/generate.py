@@ -123,6 +123,21 @@ def cast_params_for_inference(model: TransformerLM, params: Any) -> Any:
     )
 
 
+def quantize_for_decode(model: TransformerLM, params: Any):
+    """(model, fp32 params) -> (int8 model, int8 params): weights stored
+    int8 with per-out-channel scales so each decode step streams a quarter
+    of the HBM bytes (orion_tpu/quant.py). Reusable across generate calls —
+    quantize once, serve many."""
+    from orion_tpu.quant import quantize_params_for_decode
+
+    qmodel = TransformerLM(model.cfg, mesh=model.mesh, quant="int8")
+    example = jnp.zeros((1, 8), jnp.int32)
+    qparams = jax.jit(
+        lambda p: quantize_params_for_decode(qmodel, p, example)
+    )(params)
+    return qmodel, qparams
+
+
 def generate(
     model: TransformerLM,
     params: Any,
@@ -132,8 +147,12 @@ def generate(
     rng: Optional[Array] = None,
     mesh: Optional[Any] = None,
     cast_params: bool = False,
+    quant: str = "",
 ) -> Array:
     """Batched generation; one compile per (prompt_len, max_new_tokens).
+
+    ``quant="int8"``: quantize weights for this call (for repeated serving,
+    call :func:`quantize_for_decode` once and pass its results instead).
 
     ``mesh``: decode over a device mesh (SURVEY.md P1–P4 applied to
     inference). Params are placed by the training sharding rules (fsdp
@@ -161,6 +180,7 @@ def generate(
                 / max(cfg.moe_top_k, 1),
             ),
             mesh=model.mesh,
+            quant=model.quant,
         )
     if prompt.ndim == 1:
         prompt = prompt[None]
@@ -169,7 +189,14 @@ def generate(
         f"prompt {prompt.shape[1]} + new {max_new_tokens} exceeds max_seq_len {cap}"
     )
     prompt = jnp.asarray(prompt, jnp.int32)
-    if cast_params:
+    if quant:
+        assert quant == "int8", quant
+        if not model.quant:
+            model, params = quantize_for_decode(model, params)
+    if cast_params and not (quant or model.quant):
+        # quantized trees are already minimal, and blanket-casting would
+        # round the fp32 *_s scale vectors to bf16, breaking the exact
+        # per-out-channel dequant contract for no memory win
         params = cast_params_for_inference(model, params)
     if mesh is not None:
         from orion_tpu.parallel.sharding import (
@@ -243,6 +270,9 @@ def main(argv=None) -> int:
     )
     p.add_argument("--eos", action="store_true",
                    help="stop sequences at the tokenizer's <eos>")
+    p.add_argument("--quant", default="", choices=["", "int8"],
+                   help="int8 weight-streamed decode (quarter the weight "
+                        "HBM traffic; orion_tpu/quant.py)")
     # same mesh flags as train.py / aot.py; any axis > 1 builds a mesh
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--fsdp", type=int, default=1)
@@ -315,6 +345,7 @@ def main(argv=None) -> int:
         SampleConfig(args.temperature, args.top_k, args.top_p, eos_token=eos_token),
         jax.random.PRNGKey(args.seed),
         mesh=mesh,
+        quant=args.quant,
     )
     ids = [int(t) for t in out[0]]
     if eos_token >= 0 and eos_token in ids:

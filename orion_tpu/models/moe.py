@@ -131,6 +131,7 @@ class MoEMLP(nn.Module):
 
     cfg: ModelConfig
     mesh: Optional[Any] = None
+    quant: str = ""  # "" | "int8": weight-streamed decode (orion_tpu/quant.py)
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -178,6 +179,42 @@ class MoEMLP(nn.Module):
             )
 
         # -- expert FFNs (stacked [E, ...], ep-sharded) ----------------------
+        # quant mode: int8 stacks + per-(expert, out-channel) scales applied
+        # post-einsum (exact for per-out-channel; orion_tpu/quant.py)
+        if self.quant == "int8":
+            zi, so = nn.initializers.zeros_init(), nn.initializers.ones_init()
+
+            def qparam(name, shape, out):
+                return (
+                    self.param(name + "_q", zi, shape, jnp.int8),
+                    self.param(name + "_s", so, (e, out), jnp.float32),
+                )
+
+            def qein(spec, a, qs, bshape):
+                q, s = qs
+                y = jnp.einsum(spec, a, q.astype(dt))
+                return (y.astype(jnp.float32) * s.reshape(bshape)).astype(dt)
+
+            if cfg.mlp == "swiglu":
+                wg = qparam("experts_gate", (e, d, h), h)
+                wu = qparam("experts_up", (e, d, h), h)
+            else:
+                wu = qparam("experts_up", (e, d, h), h)
+            wdn = qparam("experts_down", (e, h, d), d)
+            xe = jnp.einsum("gsd,gsec->gecd", xg.astype(dt), dispatch.astype(dt))
+            xe = self._ep_constraint(xe)
+            bs = (1, e, 1, -1)
+            if cfg.mlp == "swiglu":
+                mid = jax.nn.silu(qein("gecd,edh->gech", xe, wg, bs)) * qein(
+                    "gecd,edh->gech", xe, wu, bs
+                )
+            else:
+                mid = jax.nn.gelu(qein("gecd,edh->gech", xe, wu, bs))
+            ye = qein("gech,ehd->gecd", mid, wdn, bs)
+            ye = self._ep_constraint(ye)
+            y = jnp.einsum("gecd,gsec->gsd", ye, combine.astype(dt))
+            return y.reshape(x.shape).astype(dt)
+
         if cfg.mlp == "swiglu":
             wg = self.param("experts_gate", _expert_init(), (e, d, h), pdt)
             wu = self.param("experts_up", _expert_init(), (e, d, h), pdt)
@@ -205,20 +242,43 @@ class MoEMLP(nn.Module):
         if self.mesh is not None and self.mesh.shape.get("ep", 1) > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            if t.shape[1] % self.mesh.shape["ep"] == 0:
-                return jax.lax.with_sharding_constraint(
-                    t, NamedSharding(self.mesh, P(None, "ep", None, None))
-                )
+            ep = self.mesh.shape["ep"]
+            # E % ep != 0 would silently replicate the full [G,E,C,D]
+            # dispatch tensor on every device — an OOM-by-surprise at pod
+            # scale. Fail loudly like the k<=E assert above.
+            assert t.shape[1] % ep == 0, (
+                f"n_experts={t.shape[1]} must divide evenly over mesh "
+                f"ep={ep}; otherwise the dispatch tensor replicates"
+            )
+            return jax.lax.with_sharding_constraint(
+                t, NamedSharding(self.mesh, P(None, "ep", None, None))
+            )
         return t
 
 
 def _group_size(t: int, target: int) -> int:
     """Largest divisor of ``t`` not exceeding ``target`` (so groups tile the
-    sequence exactly and never span rows)."""
+    sequence exactly and never span rows).
+
+    Warns when the resolved size collapses far below ``target`` (e.g. prime
+    T forces groups of 1): with one token per group, per-expert capacity can
+    never bind, so training-time token dropping silently disappears and the
+    routing regime diverges from the documented capacity-factor semantics.
+    """
     if target <= 0 or t <= target:
         return t
     for s in range(min(target, t), 0, -1):
         if t % s == 0:
+            if s * 4 <= min(target, t):
+                import warnings
+
+                warnings.warn(
+                    f"moe group size degenerated to {s} (target {target}, "
+                    f"seq len {t} has no larger divisor <= target); capacity"
+                    f"-based dropping is ineffective at tiny group sizes — "
+                    f"pick a seq len with a divisor near moe_group_size",
+                    stacklevel=3,
+                )
             return s
     return t
 

@@ -74,6 +74,7 @@ class Attention(nn.Module):
     causal: bool = True
     mesh: Optional[Any] = None
     sp_local: bool = False
+    quant: str = ""  # "" | "int8": weight-streamed decode (orion_tpu/quant.py)
 
     def setup(self):
         cfg = self.cfg
@@ -82,10 +83,16 @@ class Attention(nn.Module):
         dense = lambda n, feats: nn.Dense(  # noqa: E731
             feats, use_bias=False, dtype=dt, param_dtype=pdt, name=n
         )
-        self.wq = dense("wq", h * dh)
-        self.wk = dense("wk", h * dh)
-        self.wv = dense("wv", h * dh)
-        self.wo = dense("wo", cfg.d_model)
+        if self.quant == "int8":
+            from orion_tpu.quant import Int8Dense
+
+            qdense = lambda n, feats: Int8Dense(feats, dtype=dt, name=n)  # noqa: E731
+        else:
+            qdense = dense
+        self.wq = qdense("wq", h * dh)
+        self.wk = qdense("wk", h * dh)
+        self.wv = qdense("wv", h * dh)
+        self.wo = qdense("wo", cfg.d_model)
         if self.layer_type == "linear":
             if cfg.feature_map == "learnable":
                 self.phi_proj = dense("phi_proj", dh)
@@ -293,15 +300,21 @@ def _swa_cache_from_prefill(kr: Array, v: Array, t: int, window: int) -> State:
 
 class MLP(nn.Module):
     cfg: ModelConfig
+    quant: str = ""
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
         cfg = self.cfg
         dt, pdt = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
         h = cfg.resolved_mlp_hidden
-        dense = lambda n, feats: nn.Dense(  # noqa: E731
-            feats, use_bias=False, dtype=dt, param_dtype=pdt, name=n
-        )
+        if self.quant == "int8":
+            from orion_tpu.quant import Int8Dense
+
+            dense = lambda n, feats: Int8Dense(feats, dtype=dt, name=n)  # noqa: E731
+        else:
+            dense = lambda n, feats: nn.Dense(  # noqa: E731
+                feats, use_bias=False, dtype=dt, param_dtype=pdt, name=n
+            )
         if cfg.mlp == "swiglu":
             gate = dense("gate", h)(x)
             up = dense("up", h)(x)
@@ -324,20 +337,23 @@ class Block(nn.Module):
     mesh: Optional[Any] = None
     sp_local: bool = False
     use_moe: bool = False
+    quant: str = ""
 
     def setup(self):
         self.norm1 = _norm(self.cfg, "norm1")
         self.attn = Attention(
             self.cfg, self.layer_type, self.causal, self.mesh,
-            self.sp_local, name="attn"
+            self.sp_local, quant=self.quant, name="attn"
         )
         self.norm2 = _norm(self.cfg, "norm2")
         if self.use_moe:
             from orion_tpu.models.moe import MoEMLP
 
-            self.mlp = MoEMLP(self.cfg, mesh=self.mesh, name="mlp")
+            self.mlp = MoEMLP(
+                self.cfg, mesh=self.mesh, quant=self.quant, name="mlp"
+            )
         else:
-            self.mlp = MLP(self.cfg, name="mlp")
+            self.mlp = MLP(self.cfg, quant=self.quant, name="mlp")
         self.drop = nn.Dropout(self.cfg.dropout)
 
     def __call__(self, x, mask=None, deterministic=True):
@@ -363,11 +379,17 @@ class TransformerLM(nn.Module):
 
     cfg: ModelConfig
     mesh: Optional[Any] = None
+    quant: str = ""  # "" | "int8": weight-streamed decode (orion_tpu/quant.py)
 
     def setup(self):
         cfg = self.cfg
         pdt = _dtype(cfg.param_dtype)
-        self.embed = nn.Embed(cfg.vocab_size, cfg.d_model, param_dtype=pdt)
+        if self.quant == "int8":
+            from orion_tpu.quant import Int8Embed
+
+            self.embed = Int8Embed(cfg.vocab_size, cfg.d_model)
+        else:
+            self.embed = nn.Embed(cfg.vocab_size, cfg.d_model, param_dtype=pdt)
         self.pos_embed = nn.Embed(cfg.max_seq_len, cfg.d_model, param_dtype=pdt)
         block_cls = Block
         if cfg.remat:
@@ -377,21 +399,39 @@ class TransformerLM(nn.Module):
         self.blocks = [
             block_cls(
                 cfg, lt, True, self.mesh,
-                use_moe=cfg.moe_at(i), name=f"block_{i}",
+                use_moe=cfg.moe_at(i), quant=self.quant, name=f"block_{i}",
             )
             for i, lt in enumerate(cfg.resolved_layer_types)
         ]
         self.final_norm = _norm(cfg, "final_norm")
         if not cfg.tie_embeddings:
-            self.lm_head_kernel = self.param(
-                "lm_head_kernel",
-                nn.initializers.lecun_normal(),
-                (cfg.d_model, cfg.vocab_size),
-                pdt,
-            )
+            if self.quant == "int8":
+                self.lm_head_kernel_q = self.param(
+                    "lm_head_kernel_q",
+                    nn.initializers.zeros_init(),
+                    (cfg.d_model, cfg.vocab_size),
+                    jnp.int8,
+                )
+                self.lm_head_kernel_s = self.param(
+                    "lm_head_kernel_s",
+                    nn.initializers.ones_init(),
+                    (cfg.vocab_size,),
+                    jnp.float32,
+                )
+            else:
+                self.lm_head_kernel = self.param(
+                    "lm_head_kernel",
+                    nn.initializers.lecun_normal(),
+                    (cfg.d_model, cfg.vocab_size),
+                    pdt,
+                )
 
     def _embed(self, tokens: Array, positions: Array) -> Array:
-        if self.mesh is None:
+        if self.mesh is None or self.quant:
+            # quant mode skips the fsdp replicated-constraint trick below:
+            # the int8 table is 4x smaller and the sharding rules store
+            # embedding_q REPLICATED (parallel/sharding.py), so the gather
+            # never touches an fsdp-sharded table
             x = self.embed(tokens) + self.pos_embed(positions)
             return x.astype(_dtype(self.cfg.dtype))
         # FSDP-style lookup: the tables are *stored* feature-sharded over
@@ -432,6 +472,16 @@ class TransformerLM(nn.Module):
         is ~4x slower on TPU for no useful precision gain."""
         x = self.final_norm(x)
         cdt = _dtype(self.cfg.dtype)
+        if self.quant == "int8":
+            if self.cfg.tie_embeddings:
+                return self.embed.attend(x, cdt)
+            y = jnp.einsum(
+                "...d,dv->...v",
+                x.astype(cdt),
+                self.lm_head_kernel_q.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            return y * self.lm_head_kernel_s
         if self.cfg.tie_embeddings:
             w = self.embed.embedding.astype(cdt)  # [V, D]
             return jnp.einsum(

@@ -128,6 +128,7 @@ _SIMPLE = {
     "exp": _exp,
     "identity": lambda x: x,
 }
+_BUILTIN = frozenset(_SIMPLE)  # protected from re-registration; user names aren't
 
 
 def register_feature_map(name: str, fn=None):
@@ -144,15 +145,16 @@ def register_feature_map(name: str, fn=None):
 
     The map must be positive-valued for causal linear attention (the
     normalizer q·z must stay > 0) and elementwise over the feature dim.
-    Re-registering a built-in name raises; pick a new name.
+    Re-registering a BUILT-IN name raises; re-registering your own custom
+    name overwrites it (notebook/REPL iteration).
     """
 
     def install(f):
         # "favor" and "learnable" are special-cased inside the Attention
         # module (random features / learned projection) — registering them
         # here would be silently shadowed there, so reserve the names too
-        if name in _SIMPLE or name in ("favor", "learnable"):
-            raise ValueError(f"feature map {name!r} already registered")
+        if name in _BUILTIN or name in ("favor", "learnable"):
+            raise ValueError(f"feature map {name!r} is built-in; pick a new name")
         _SIMPLE[name] = f
         return f
 

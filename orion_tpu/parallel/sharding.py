@@ -28,16 +28,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _RULES = (
     # MoE (models/moe.py): stacked expert FFNs [E, in, out] shard the expert
     # dim over ep, the matmul dims over fsdp/tp like their dense twins
-    (r"experts_(gate|up)$", P("ep", "fsdp", "tp")),
-    (r"experts_down$", P("ep", "tp", "fsdp")),
-    (r"router/kernel$", P("fsdp", None)),
-    (r"(wq|wk|wv|gate|up|phi_proj)/kernel$", P("fsdp", "tp")),
-    (r"(wo|down)/kernel$", P("tp", "fsdp")),
-    (r"lm_head_kernel$", P("fsdp", "tp")),
+    # int8 decode twins (orion_tpu/quant.py): the _q tensors shard exactly
+    # like their fp32 counterparts; the per-out-channel _s scale vectors are
+    # tiny and stay replicated (the catch-all)
+    (r"experts_(gate|up)(_q)?$", P("ep", "fsdp", "tp")),
+    (r"experts_down(_q)?$", P("ep", "tp", "fsdp")),
+    # router kernel [d, E] is tiny; replicating it keeps the fp32 routing
+    # logits' layout free for GSPMD (fsdp-sharding it forced an involuntary
+    # full rematerialization of the logits under fsdp x ep meshes)
+    (r"router/kernel$", P(None, None)),
+    (r"(wq|wk|wv|gate|up|phi_proj)/kernel(_q)?$", P("fsdp", "tp")),
+    (r"(wo|down)/kernel(_q)?$", P("tp", "fsdp")),
+    (r"lm_head_kernel(_q)?$", P("fsdp", "tp")),
     (r"head/kernel$", P("fsdp", None)),
+    # the int8 token table is replicated (4x smaller than fp32): gather on
+    # an fsdp-sharded table is the documented GSPMD full-remat pathology
+    # (see TransformerLM._embed), and the quant path skips that module's
+    # replicated-constraint workaround
+    (r"(embed|embedding|pos_embed)/embedding_q$", P(None, None)),
     (r"(embed|embedding|pos_embed)/embedding$", P(None, "fsdp")),
     (r"favor_proj$", P(None, None)),
-    (r"", P()),  # norms, biases, cls, everything else: replicated
+    (r"", P()),  # norms, biases, scales, cls, everything else: replicated
 )
 
 

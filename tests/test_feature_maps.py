@@ -80,3 +80,13 @@ def test_register_custom_feature_map():
 
     with pytest.raises(ValueError):
         register_feature_map("elu1", lambda x: x)  # built-ins protected
+
+    # re-registering a USER name overwrites (notebook/REPL iteration),
+    # only built-ins + reserved names are protected
+    register_feature_map("softplus_test", lambda x: jax.nn.softplus(x) + 1.0)
+    fm2 = make_feature_map("softplus_test")
+    np.testing.assert_allclose(
+        np.asarray(fm2(x)), np.asarray(jax.nn.softplus(x) + 1.0), atol=1e-6
+    )
+    with pytest.raises(ValueError):
+        register_feature_map("favor", lambda x: x)  # reserved

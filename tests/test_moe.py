@@ -166,7 +166,26 @@ class TestMoEMLP:
         assert _group_size(2048, 512) == 512
         assert _group_size(100, 512) == 100
         assert _group_size(96, 50) == 48
-        assert _group_size(7, 4) == 1  # prime: degenerates to singletons
+        # prime seq len degenerates to singleton groups — capacity can never
+        # bind there, so the resolver warns about the regime change
+        with pytest.warns(UserWarning, match="degenerated"):
+            assert _group_size(7, 4) == 1
+
+    def test_ep_mesh_must_divide_experts(self):
+        """E % ep != 0 must fail loudly, not silently replicate the
+        [G,E,C,D] dispatch tensor on every device."""
+        from jax.sharding import Mesh
+
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=3, moe_top_k=1, dtype="float32",
+            moe_group_size=8,
+        )
+        devs = np.array(jax.devices()[:2]).reshape(2)
+        mesh = Mesh(devs, ("ep",))
+        m = MoEMLP(cfg, mesh=mesh)
+        x = jnp.zeros((2, 16, 16))
+        with pytest.raises(AssertionError, match="divide evenly"):
+            m.init(jax.random.PRNGKey(0), x)
 
     def test_decode_rank2_never_drops(self):
         """Decode input [B, D] uses capacity = B: even if every row routes

@@ -1,0 +1,156 @@
+"""Int8 weight-streamed decode (orion_tpu/quant.py; VERDICT r2 #1).
+
+Parity contract: per-out-channel int8 is exact up to rounding of the
+weights (~0.4% RMS per matmul); on a TRAINED model (confident logits) the
+greedy decode tokens must be bitwise identical to the fp32 path."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.generate import SampleConfig, generate, quantize_for_decode
+from orion_tpu.models.configs import ModelConfig
+from orion_tpu.models.transformer import TransformerLM
+from orion_tpu.quant import quantize_int8
+
+
+def _hybrid_cfg(**kw):
+    base = dict(
+        name="t", vocab_size=64, d_model=64, n_layers=3, n_heads=4,
+        layer_types=("linear", "swa", "softmax"), window=8,
+        max_seq_len=64, dtype="float32",
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def test_quantize_int8_roundtrip():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * jnp.linspace(
+        0.01, 3.0, 32
+    )  # per-channel spread: per-tensor scaling would lose the small columns
+    q, s = quantize_int8(w, (0,))
+    assert q.dtype == jnp.int8 and s.shape == (32,)
+    w2 = q.astype(jnp.float32) * s
+    # per-channel bound: |w - q*s| <= s/2 per column
+    assert np.all(np.abs(np.asarray(w2 - w)) <= np.asarray(s) / 2 + 1e-9)
+
+
+@pytest.mark.parametrize("tie", [True, False])
+def test_quant_forward_close(tie):
+    """Quantized forward logits track fp32 within the int8 rounding budget
+    on all three layer types (linear / swa / softmax)."""
+    cfg = _hybrid_cfg(tie_embeddings=tie)
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    logits = np.asarray(model.apply(params, toks))
+    qmodel, qparams = quantize_for_decode(model, params)
+    qlogits = np.asarray(qmodel.apply(qparams, toks))
+    scale = np.abs(logits).max()
+    assert np.abs(qlogits - logits).max() < 0.05 * scale
+
+
+def _overfit(cfg, steps=150):
+    """Train the tiny model to confident logits on one repeated batch —
+    the 'real checkpoint' stand-in for greedy-equality testing."""
+    import optax
+
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(3), (4, 32), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    opt = optax.adam(3e-3)
+    ost = opt.init(params)
+
+    @jax.jit
+    def step(params, ost):
+        def loss_fn(p):
+            logits = model.apply(p, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], toks[:, 1:]
+            ).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        up, ost = opt.update(g, ost)
+        return optax.apply_updates(params, up), ost, loss
+
+    for _ in range(steps):
+        params, ost, loss = step(params, ost)
+    assert float(loss) < 0.5, float(loss)
+    return model, params, toks
+
+
+def test_quant_greedy_token_equality_trained():
+    """VERDICT r2 #1 'done' bar: greedy tokens identical to fp32 on a
+    trained checkpoint."""
+    cfg = _hybrid_cfg()
+    model, params, toks = _overfit(cfg)
+    prompt = toks[:2, :8]
+    out = generate(model, params, prompt, 24, SampleConfig(temperature=0.0))
+    qout = generate(
+        model, params, prompt, 24, SampleConfig(temperature=0.0), quant="int8"
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(qout))
+
+
+def test_quant_prequantized_reuse():
+    """quantize_for_decode once, serve many: passing the quantized
+    (model, params) directly must equal the quant= path."""
+    cfg = _hybrid_cfg()
+    model, params, toks = _overfit(cfg, steps=80)
+    prompt = toks[:1, :8]
+    qmodel, qparams = quantize_for_decode(model, params)
+    a = generate(model, params, prompt, 12, SampleConfig(0.0), quant="int8")
+    b = generate(qmodel, qparams, prompt, 12, SampleConfig(0.0))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quant_moe_forward_close():
+    """MoE expert stacks quantize too (per-(expert, out-channel) scales);
+    serve in the no-drop regime like generate() does."""
+    cfg = ModelConfig(
+        name="t", vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+        max_seq_len=64, dtype="float32", n_experts=4, moe_period=2,
+        moe_top_k=1, moe_capacity_factor=4.0, moe_group_size=16,
+    )
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    logits = np.asarray(model.apply(params, toks))
+    qmodel, qparams = quantize_for_decode(model, params)
+    qlogits = np.asarray(qmodel.apply(qparams, toks))
+    # router stays fp32, so routing decisions are identical and the error
+    # budget is the experts' int8 rounding
+    assert np.abs(qlogits - logits).max() < 0.08 * np.abs(logits).max()
+
+
+def test_quant_cast_params_noop():
+    """cast_params=True with quant must NOT bf16-round the fp32 scale
+    vectors — the quantized tree is already minimal and the cast is
+    skipped (code-review r3 finding)."""
+    cfg = _hybrid_cfg(dtype="bfloat16")
+    model = TransformerLM(cfg)
+    toks = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    a = generate(model, params, toks, 8, SampleConfig(0.0), quant="int8")
+    b = generate(
+        model, params, toks, 8, SampleConfig(0.0), quant="int8",
+        cast_params=True,
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quant_sampled_decode_runs():
+    """Non-greedy sampling through the quant path stays finite/valid."""
+    cfg = _hybrid_cfg()
+    model = TransformerLM(cfg)
+    toks = jnp.ones((2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    out = generate(
+        model, params, toks, 8,
+        SampleConfig(temperature=0.8, top_k=16), quant="int8",
+    )
+    o = np.asarray(out)
+    assert o.shape == (2, 8) and (o >= 0).all() and (o < 64).all()
