@@ -170,8 +170,10 @@ def generate(
     parallel forward then provably keeps every token; models/moe.py).
     """
     cfg = model.cfg
-    if cfg.n_experts > 0 and cfg.moe_capacity_factor < cfg.n_experts / max(
-        cfg.moe_top_k, 1
+    if (
+        cfg.n_experts > 0
+        and not cfg.moe_dropless  # dropless has no capacity to bump
+        and cfg.moe_capacity_factor < cfg.n_experts / max(cfg.moe_top_k, 1)
     ):
         model = TransformerLM(
             dataclasses.replace(

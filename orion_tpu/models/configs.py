@@ -60,6 +60,11 @@ class ModelConfig:
     moe_period: int = 2  # every moe_period-th block is MoE
     moe_top_k: int = 1  # 1 = Switch routing
     moe_capacity_factor: float = 1.25
+    # dropless routing (models/moe.py): tokens sorted by expert and run
+    # through jax.lax.ragged_dot — every token reaches every chosen expert
+    # (no capacity, no train/serve asymmetry). Single-host meshes only
+    # (dp/fsdp/tp); capacity dispatch remains the ep-scalable path.
+    moe_dropless: bool = False
     moe_group_size: int = 512  # GShard local-group length (0 = whole row)
     moe_aux_weight: float = 1e-2  # load-balance loss weight
     moe_zloss_weight: float = 1e-3  # router z-loss weight
@@ -124,6 +129,23 @@ HYBRID_7B = ModelConfig(
     layer_types=hybrid_pattern(32, period=4),
     window=1024,
     max_seq_len=4096,
+    dtype="bfloat16",
+    remat=True,
+)
+
+HYBRID_1B3 = ModelConfig(
+    # chip-sized hybrid (M4 evidence, VERDICT r2 #4): the 7B layout — swa
+    # W=1024 with a global linear layer every 4th block — at lm_1b3 width,
+    # so rotary + flash-swa + linear kernels + remat interact in ONE real
+    # measured train step on the 16GB chip (hybrid_7b only AOT-compiles).
+    name="hybrid_1b3",
+    vocab_size=32000,
+    d_model=2048,
+    n_layers=24,
+    n_heads=16,
+    layer_types=hybrid_pattern(24, period=4),
+    window=1024,
+    max_seq_len=2048,
     dtype="bfloat16",
     remat=True,
 )
@@ -195,6 +217,7 @@ CONFIGS = {
     for c in [
         TINY,
         LM_1B3,
+        HYBRID_1B3,
         HYBRID_7B,
         MOE_1B3_8E,
         MOE_1B3_4E,

@@ -20,3 +20,74 @@ jax.config.update("jax_threefry_partitionable", True)
 
 assert jax.default_backend() == "cpu", jax.devices()
 assert jax.device_count() >= 8, jax.devices()
+
+
+# -- quick/slow tiers ---------------------------------------------------------
+# Tests >=10s single-process on this 1-core box (from `pytest --durations`),
+# marked centrally so the list is regenerable. Dev loop: `-m "not slow"`
+# (~9 min); the full suite (~36 min) stays the merge gate.
+_SLOW = {
+    "test_pipeline.py::test_pp_transformer_lm_parity",
+    "test_generate.py::test_long_decode_past_window",
+    "test_moe.py::TestMoEDecode::test_greedy_decode_matches_parallel_argmax",
+    "test_pipeline.py::test_pp_dropout_rng_plumbing",
+    "test_pipeline.py::test_pp_hybrid_model_parity",
+    "test_sharding.py::test_sp_linear_attention_fused_pallas_path[2]",
+    "test_sharding.py::test_ring_attention_grads",
+    "test_pipeline.py::test_pipeline_grad_parity",
+    "test_lra.py::test_listops_synthetic_learnable_softmax",
+    "test_moe.py::TestMoETraining::test_moe_composes_with_pp_and_sp",
+    "test_moe.py::TestMoEDecode::test_generate_auto_bumps_capacity_for_serving",
+    "test_lra.py::test_listops_synthetic_learnable_linear",
+    "test_generate.py::test_greedy_decode_matches_parallel_argmax",
+    "test_lra.py::test_text_synthetic_learnable",
+    "test_sharding.py::test_sp_linear_attention_fused_pallas_path[4]",
+    "test_moe.py::TestMoETraining::test_moe_composes_with_sequence_parallel",
+    "test_pipeline.py::test_trainer_pipeline_parallel_parity",
+    "test_sharding.py::test_trainer_sequence_parallel_parity",
+    "test_training.py::test_checkpoint_restores_across_meshes",
+    "test_sharding.py::test_sp_linear_attention_grads",
+    "test_moe.py::TestMoETraining::test_trainer_step_and_loss_includes_aux",
+    "test_training.py::test_pp_checkpoint_serves_via_unstack",
+    "test_moe.py::test_classifier_honors_moe_config",
+    "test_moe.py::TestMoETraining::test_pp_moe_parity_single_microbatch",
+    "test_moe.py::test_moe_checkpoint_restores_across_ep_meshes",
+    "test_moe.py::TestMoETraining::test_trainer_parity_across_ep_meshes[dp2ep4]",
+    "test_moe.py::TestMoETraining::test_trainer_parity_across_ep_meshes[dp2tp2ep2]",
+    "test_moe.py::TestMoEDecode::test_moe_checkpoint_serves_via_cli",
+    "test_training.py::test_grad_accumulation_matches_big_batch",
+    "test_moe.py::TestMoETraining::test_moe_overfits_synthetic",
+    "test_moe.py::TestMoEMLP::test_decode_rank2_never_drops",
+    "test_sharding.py::test_trainer_parity_across_meshes[dp2f2t2]",
+    "test_sharding.py::test_trainer_parity_across_meshes[dp8]",
+    "test_pipeline.py::test_trainer_pp_accum_and_odd_batch",
+    "test_pipeline.py::test_pipeline_forward_parity[2-4]",
+    "test_pipeline.py::test_pipeline_forward_parity[4-4]",
+    "test_bpe.py::test_prepare_data_bpe_and_train",
+    "test_models.py::test_remat_policy_dots_matches",
+    "test_models.py::test_classifier_padding_invariance",
+    "test_models.py::test_parallel_vs_prefill_decode_parity[elu1]",
+    "test_pipeline.py::test_trainer_pp_sp_composition_parity[xla]",
+    "test_pipeline.py::test_trainer_pp_sp_composition_parity[pallas_interpret]",
+    "test_moe.py::TestMoEMLP::test_causal_under_drops[1]",
+    "test_generate.py::test_sharded_generate_parity",
+    "test_pallas_causal_dot.py::test_pallas_grad_through_state_chain",
+    "test_aot.py::test_scaled_hybrid_compiles_with_collectives",
+    "test_aot.py::test_hybrid_7b_lowers_sharded",
+    "test_models.py::test_decode_from_zero_state",
+    "test_training.py::test_checkpoint_resume_bitwise",
+    "test_sharding.py::test_ring_attention_matches_softmax[True]",
+    "test_quant.py::test_quant_greedy_token_equality_trained",
+    "test_quant.py::test_quant_prequantized_reuse",
+    "test_quant.py::test_quant_cast_params_noop",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        # nodeid relative to tests/: "test_x.py::TestC::test_y[param]"
+        nid = item.nodeid.split("tests/")[-1]
+        if nid in _SLOW:
+            item.add_marker(pytest.mark.slow)

@@ -171,6 +171,133 @@ class TestMoEMLP:
         with pytest.warns(UserWarning, match="degenerated"):
             assert _group_size(7, 4) == 1
 
+    def test_dropless_matches_capacity_when_nothing_drops(self):
+        """With capacity at the no-drop bound (cf = E/k), the capacity path
+        provably keeps every token — the dropless sort-based path must
+        produce the same outputs (same router, same experts, same gates)."""
+        for k in (1, 2):
+            cfg = ModelConfig(
+                name="t", d_model=16, n_experts=4, moe_top_k=k,
+                moe_capacity_factor=4.0 / k, moe_group_size=16,
+                dtype="float32",
+            )
+            m_cap = MoEMLP(cfg)
+            m_free = MoEMLP(dataclasses.replace(cfg, moe_dropless=True))
+            x = jax.random.normal(jax.random.PRNGKey(k), (2, 16, 16))
+            p = m_cap.init(jax.random.PRNGKey(1), x)
+            # identical param trees: checkpoints move between the two paths
+            jax.tree.map(
+                lambda a, b: None,
+                p, m_free.init(jax.random.PRNGKey(2), x),
+            )
+            np.testing.assert_allclose(
+                np.asarray(m_cap.apply(p, x)),
+                np.asarray(m_free.apply(p, x)),
+                atol=2e-5, rtol=2e-5,
+            )
+
+    def test_dropless_never_drops_under_tight_capacity_cfg(self):
+        """moe_capacity_factor is a no-op for dropless: outputs equal the
+        no-drop reference even at cf that would make the capacity path drop
+        most assignments."""
+        base = ModelConfig(
+            name="t", d_model=16, n_experts=4, moe_top_k=1,
+            moe_group_size=16, dtype="float32", moe_dropless=True,
+        )
+        tight = dataclasses.replace(base, moe_capacity_factor=0.25)
+        loose = dataclasses.replace(base, moe_capacity_factor=4.0)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16))
+        p = MoEMLP(base).init(jax.random.PRNGKey(1), x)
+        np.testing.assert_allclose(
+            np.asarray(MoEMLP(tight).apply(p, x)),
+            np.asarray(MoEMLP(loose).apply(p, x)),
+            atol=1e-6,
+        )
+        # while the capacity path at cf=0.25 visibly differs (it drops)
+        cap = MoEMLP(dataclasses.replace(tight, moe_dropless=False))
+        assert not np.allclose(
+            np.asarray(cap.apply(p, x)), np.asarray(MoEMLP(tight).apply(p, x))
+        )
+
+    def test_dropless_router_gets_gradient(self):
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=4, moe_top_k=2,
+            dtype="float32", moe_dropless=True,
+        )
+        m = MoEMLP(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16))
+        p = m.init(jax.random.PRNGKey(1), x)
+
+        def loss(p):
+            y, aux = m.apply(p, x, mutable="losses")
+            return (y**2).mean() + sum(jax.tree.leaves(aux["losses"]))
+
+        g = jax.grad(loss)(p)
+        gr = np.asarray(g["params"]["router"]["kernel"])
+        assert np.abs(gr).max() > 0
+
+    def test_dropless_rejects_ep_mesh(self):
+        from jax.sharding import Mesh
+
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=4, moe_top_k=1,
+            dtype="float32", moe_dropless=True,
+        )
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("ep",))
+        m = MoEMLP(cfg, mesh=mesh)
+        with pytest.raises(AssertionError, match="dropless"):
+            m.init(jax.random.PRNGKey(0), jnp.zeros((2, 16, 16)))
+
+    def test_dropless_decode_matches_parallel_argmax(self):
+        """The asymmetry dropless kills: parallel forward == recurrent
+        decode WITHOUT any capacity bump, even at a cf that would make the
+        capacity path's prefill drop tokens."""
+        from orion_tpu.generate import SampleConfig, generate
+        from orion_tpu.models.transformer import TransformerLM
+
+        cfg = ModelConfig(
+            name="t", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+            max_seq_len=64, dtype="float32", n_experts=4, moe_period=2,
+            moe_top_k=1, moe_capacity_factor=0.25, moe_dropless=True,
+        )
+        model = TransformerLM(cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(0), (2, 12), 0, 64)
+        params = model.init(jax.random.PRNGKey(1), toks)
+        n_new = 8
+        out = np.asarray(
+            generate(model, params, toks, n_new, SampleConfig(0.0))
+        )
+        # reference: token-by-token argmax through the PARALLEL forward
+        cur = np.asarray(toks)
+        for _ in range(n_new):
+            logits = np.asarray(model.apply(params, jnp.asarray(cur)))
+            cur = np.concatenate(
+                [cur, logits[:, -1].argmax(-1)[:, None].astype(np.int32)], 1
+            )
+        np.testing.assert_array_equal(out, cur[:, toks.shape[1]:])
+
+    def test_dropless_trainer_step(self):
+        from orion_tpu.training.data import SyntheticDataset
+        from orion_tpu.training.trainer import TrainConfig, Trainer
+
+        model = ModelConfig(
+            name="t", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+            max_seq_len=64, dtype="float32", n_experts=4, moe_period=2,
+            moe_top_k=2, moe_dropless=True,
+        )
+        cfg = TrainConfig(
+            model=model, steps=6, batch_size=4, seq_len=16, lr=3e-3,
+            warmup_steps=1, mesh=MeshConfig(dp=1), log_every=1,
+        )
+        tr = Trainer(cfg)
+        batch = jnp.asarray(SyntheticDataset(64, 16).batch(0, 0, 4))
+        first = float(tr.step(batch)["loss"])
+        last = first
+        for _ in range(5):
+            last = float(tr.step(batch)["loss"])
+        assert np.isfinite(first) and np.isfinite(last)
+        assert last < first
+
     def test_ep_mesh_must_divide_experts(self):
         """E % ep != 0 must fail loudly, not silently replicate the
         [G,E,C,D] dispatch tensor on every device."""
