@@ -1,0 +1,74 @@
+"""Regenerate the `data/lra_sample/` worked example (VERDICT r2 #9).
+
+Ships REAL-FORMAT LRA TSVs — `<label>\t<sequence>` rows, the exact layout
+`orion_tpu.train_lra.TSVDataset` ingests (reference checkout never mounted —
+SURVEY.md §0) — with synthetic CONTENT, since network egress is blocked and
+the true ListOps/IMDB downloads are unreachable from this box. Swapping in
+the real downloads is a file copy: same filenames, same row format.
+
+- `listops/{train,val}.tsv`: space-separated token ids (the "ids" mode the
+  lra_listops_* configs select), content from the SyntheticListOps
+  generator so the label rule matches the benched stand-in task.
+- `text/{train,val}.tsv`: raw printable text (the "bytes" mode the
+  lra_text_* configs select). Content is random a-z words; label = whether
+  'e' occurs more often in the first half than the second — long-range by
+  construction, printable by construction (real byte-level IMDB rows drop
+  in unchanged).
+
+Run:  python data/lra_sample/make_sample.py
+Train on it (see README):
+  python -m orion_tpu.train_lra --config lra_listops_linear \
+      --task data/lra_sample/listops --seq-len 256 --steps 200
+  python -m orion_tpu.train_lra --config lra_text_linear \
+      --task data/lra_sample/text --seq-len 256 --steps 200
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", ".."))
+
+from orion_tpu.train_lra import SyntheticListOps  # noqa: E402
+
+
+def write_listops(path: str, n: int, seq_len: int, seed: int) -> None:
+    ds = SyntheticListOps(seq_len)
+    toks, labels, _ = ds.batch(seed, 0, n)
+    with open(path, "w") as f:
+        for row, label in zip(toks, labels):
+            f.write(f"{int(label)}\t{' '.join(str(int(t)) for t in row)}\n")
+
+
+def write_text(path: str, n: int, seq_len: int, seed: int) -> None:
+    rng = np.random.Generator(np.random.Philox(key=[seed, 0]))
+    letters = np.array(list("abcdefghijklmnopqrstuvwxyz"))
+    with open(path, "w") as f:
+        for _ in range(n):
+            chars = []
+            while len(chars) < seq_len:
+                w = rng.integers(2, 9)
+                chars.extend(letters[rng.integers(0, 26, size=w)])
+                chars.append(" ")
+            text = "".join(chars[:seq_len]).strip()
+            half = len(text) // 2
+            label = int(text[:half].count("e") > text[half:].count("e"))
+            f.write(f"{label}\t{text}\n")
+
+
+def main() -> None:
+    for task in ("listops", "text"):
+        os.makedirs(os.path.join(HERE, task), exist_ok=True)
+    write_listops(os.path.join(HERE, "listops", "train.tsv"), 512, 256, seed=0)
+    write_listops(os.path.join(HERE, "listops", "val.tsv"), 128, 256, seed=1)
+    write_text(os.path.join(HERE, "text", "train.tsv"), 512, 256, seed=0)
+    write_text(os.path.join(HERE, "text", "val.tsv"), 128, 256, seed=1)
+    print(f"wrote lra_sample under {HERE}")
+
+
+if __name__ == "__main__":
+    main()

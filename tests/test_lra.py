@@ -73,6 +73,28 @@ def test_synthetic_datasets_deterministic():
         assert (l1 >= 0).all() and (l1 < ds.n_classes).all()
 
 
+@pytest.mark.parametrize("task,config", [
+    ("listops", "lra_listops_linear"), ("text", "lra_text_linear")
+])
+def test_shipped_lra_sample_end_to_end(task, config):
+    """The real-format worked example (data/lra_sample/, VERDICT r2 #9)
+    trains end-to-end through the TSV ingestion path: a few steps on the
+    shipped train.tsv, eval on the shipped val.tsv."""
+    import os
+
+    data_dir = os.path.join(
+        os.path.dirname(__file__), "..", "data", "lra_sample", task
+    )
+    if not os.path.isdir(data_dir):
+        pytest.skip("sample not generated (data/lra_sample/make_sample.py)")
+    cfg = _cfg(
+        config, task=data_dir, steps=6, seq_len=64, eval_every=6,
+        eval_batches=2, warmup_steps=2,
+    )
+    _, last = train_lra(cfg)
+    assert np.isfinite(last["loss"]) and "eval_acc" in last, last
+
+
 def test_tsv_dataset(tmp_path):
     from orion_tpu.train_lra import TSVDataset
 
