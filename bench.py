@@ -271,6 +271,10 @@ def main(argv=None) -> int:
                     help="also run the Pallas-vs-XLA kernel micro-bench")
     ap.add_argument("--moe", action="store_true",
                     help="also bench the moe_1b3_4e chip-scale sparse config")
+    ap.add_argument("--hybrid", action="store_true",
+                    help="bench the hybrid_1b3 config (swa W=1024 + global "
+                         "linear, the 7B layout at chip scale) even under "
+                         "--quick; full (no-flag) runs always include it")
     ap.add_argument("--quick", action="store_true",
                     help="train bench only, fewer iters")
     ap.add_argument("--decode-matrix", action="store_true",
@@ -288,26 +292,61 @@ def main(argv=None) -> int:
     res = bench_train(iters=5 if args.quick else 10)
 
     if not args.quick:
-        for name, kw in [
-            ("decode_p50_ms_per_token_tiny", dict(config="tiny")),
-            ("decode_p50_ms_per_token_lm1b3_b1_p512",
-             dict(config="lm_1b3", prompt_len=512, n_tokens=32)),
-            ("decode_p50_ms_per_token_lm1b3_b1_p512_int8",
-             dict(config="lm_1b3", prompt_len=512, n_tokens=32, quant="int8")),
-            ("decode_p50_ms_per_token_lm1b3_b8_p512",
-             dict(config="lm_1b3", prompt_len=512, n_tokens=32, batch_size=8)),
-        ]:
-            try:
-                ms = bench_decode(**kw)
-                print(json.dumps({name: round(ms, 4)}), file=sys.stderr)
-            except Exception as e:
-                print(f"{name} failed: {e}", file=sys.stderr)
+        # the driver invokes bench.py with NO flags, so everything the round
+        # artifact (BENCH_rN.json) must show runs here by default: the
+        # one-process decode matrix (VERDICT r2 #7 — subsumes the old
+        # per-row lm_1b3 decode benches with same-run ratios) and the
+        # chip-sized hybrid rows (VERDICT r2 #4). --kernels/--moe stay
+        # opt-in extras.
+        try:
+            ms = bench_decode(config="tiny")
+            print(json.dumps({"decode_p50_ms_per_token_tiny": round(ms, 4)}),
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"tiny decode failed: {e}"[:200], file=sys.stderr)
+        _free_device_memory()
+        try:
+            mat = decode_matrix()
+            print(json.dumps({"decode_matrix": mat}), file=sys.stderr)
+        except Exception as e:
+            print(f"decode matrix failed: {e}"[:200], file=sys.stderr)
 
     if args.kernels:
         from orion_tpu.bench_kernels import run_all
 
         for row in run_all():
             print(json.dumps(row), file=sys.stderr)
+
+    if args.hybrid or not args.quick:
+        # chip-sized hybrid (VERDICT r2 #4): rotary + flash-swa + linear
+        # kernels + remat in one measured step — the interaction hybrid_7b's
+        # AOT-only story never exercises on hardware. try/except: a hybrid
+        # failure must not cost the headline lm_1b3 metric line below.
+        _free_device_memory()
+        try:
+            hyb = bench_train(
+                iters=5 if args.quick else 10, config="hybrid_1b3"
+            )
+            hyb["config"] = "hybrid_1b3"
+            hyb["vs_dense_lm1b3"] = round(
+                hyb["tokens_per_sec"] / res["tokens_per_sec"], 4
+            )
+            print(json.dumps({"hybrid_detail": hyb}), file=sys.stderr)
+        except Exception as e:
+            print(f"hybrid train bench failed: {e}"[:200], file=sys.stderr)
+        _free_device_memory()
+        for name, kw in [
+            ("decode_p50_ms_per_token_hybrid1b3_b1_p512",
+             dict(config="hybrid_1b3", prompt_len=512, n_tokens=32)),
+            ("decode_p50_ms_per_token_hybrid1b3_b1_p512_int8",
+             dict(config="hybrid_1b3", prompt_len=512, n_tokens=32,
+                  quant="int8")),
+        ]:
+            try:
+                ms = bench_decode(**kw)
+                print(json.dumps({name: round(ms, 4)}), file=sys.stderr)
+            except Exception as e:
+                print(f"{name} failed: {e}"[:200], file=sys.stderr)
 
     if args.moe:
         # chip-scale sparse config: 1.89B total params, same 1.28B active

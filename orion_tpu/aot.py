@@ -55,22 +55,47 @@ def _collective_counts(hlo_text: str) -> Dict[str, int]:
     counts: Dict[str, int] = collections.Counter()
     for op in ops:
         counts[op] = len(re.findall(rf"\b{op}(?:-start)?\(", hlo_text))
+    # Mosaic kernels land as custom-calls with target "tpu_custom_call":
+    # >0 is the proof a backend="pallas" plan actually carries the kernels
+    # (vs silently falling back to the XLA forms). Counting bare
+    # `custom-call(` would also count AllocateBuffer / async-collective
+    # plumbing and overstate kernel presence.
+    counts["mosaic_kernels"] = len(
+        re.findall(r'custom_call_target="tpu_custom_call"', hlo_text)
+    )
     return dict(counts)
+
+
+def topology_mesh(topology: str, mesh_cfg) -> Any:
+    """Mesh over a named TPU topology's ABSTRACT devices (e.g. "v5e:2x4") —
+    no hardware attached: jax's topology AOT path hands the real TPU
+    compiler (Mosaic included) the target platform, so a plan validated
+    here is the exact executable a pod of that shape would run. This is
+    strictly stronger evidence than the virtual-CPU mesh: CPU numbers come
+    from the CPU backend's memory model and skip Mosaic entirely."""
+    from jax.experimental import topologies
+
+    from orion_tpu.parallel.mesh import make_mesh
+
+    topo = topologies.get_topology_desc(platform="tpu", topology_name=topology)
+    return make_mesh(mesh_cfg.resolve(len(topo.devices)), devices=topo.devices)
 
 
 def plan(
     cfg,
     compile_step: bool = True,
     hlo: bool = False,
+    mesh: Any = None,
 ) -> Dict[str, Any]:
     """Lower (and optionally compile) the sharded train step for
-    ``cfg: TrainConfig``; return the planning report dict."""
+    ``cfg: TrainConfig``; return the planning report dict. ``mesh``
+    overrides the config-derived device mesh (the --topology path)."""
     import jax
     import numpy as np
 
     from orion_tpu.training.trainer import Trainer
 
-    trainer = Trainer(cfg, materialize=False)
+    trainer = Trainer(cfg, mesh=mesh, materialize=False)
     abstract = trainer.abstract_state()
     batch = jax.ShapeDtypeStruct(
         (cfg.batch_size, cfg.seq_len + 1), np.int32, sharding=trainer.batch_shd
@@ -145,9 +170,23 @@ def main(argv=None) -> int:
                    help="skip XLA compilation (faster; no memory analysis)")
     p.add_argument("--force-cpu-devices", type=int, default=0,
                    help="plan on N virtual CPU devices instead of real chips")
+    p.add_argument("--topology", default="",
+                   help="plan against a named TPU topology's real compiler "
+                        "without hardware, e.g. v5e:2x4 (overrides "
+                        "--force-cpu-devices)")
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="ModelConfig override, e.g. --set backend=pallas")
     args = p.parse_args(argv)
 
-    if args.force_cpu_devices:
+    if args.topology:
+        # the topology client compiles for the named TPU target; the DEFAULT
+        # backend is only ever touched for small concrete arrays (rng keys),
+        # and on this kind of box the default TPU plugin may be busy or
+        # absent — keep those on cpu so planning never waits on a chip
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    elif args.force_cpu_devices:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -160,6 +199,10 @@ def main(argv=None) -> int:
     from orion_tpu.training.trainer import TrainConfig
 
     model = get_config(args.config)
+    if args.set:
+        from orion_tpu.utils.config import apply_overrides, parse_set_overrides
+
+        model = apply_overrides(model, parse_set_overrides(args.set))
     seq_len = args.seq_len or model.max_seq_len
     if seq_len > model.max_seq_len:
         model = dataclasses.replace(model, max_seq_len=seq_len)
@@ -171,7 +214,10 @@ def main(argv=None) -> int:
         mesh=MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp,
                         pp=args.pp, ep=args.ep),
     )
-    report = plan(cfg, compile_step=not args.lower_only)
+    mesh = topology_mesh(args.topology, cfg.mesh) if args.topology else None
+    report = plan(cfg, compile_step=not args.lower_only, mesh=mesh)
+    if args.topology:
+        report["topology"] = args.topology
     print(json.dumps(report))
     return 0
 

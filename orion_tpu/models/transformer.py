@@ -143,6 +143,25 @@ class Attention(nn.Module):
             out = jnp.swapaxes(out, -3, -2)  # [B, T, H, Dh]
         return self.wo(out.reshape(*out.shape[:-2], -1))
 
+    def _kernel_bh(self, fn, *args):
+        """Kernel dispatch for per-(batch, head)-parallel attention: on a
+        GSPMD mesh whose data axes split, a Mosaic kernel must be
+        manualized (XLA cannot auto-partition tpu_custom_call) — shard_map
+        over (dp, fsdp, tp) via parallel/kernel_shard.py; everywhere else
+        the call goes straight through."""
+        from orion_tpu.ops.dispatch import resolve
+        from orion_tpu.parallel.kernel_shard import needs_manual, shard_map_bh
+
+        b = resolve(self.cfg.backend)
+        if needs_manual(self.mesh, b):
+            # vma ON for real Mosaic (its lowering requires it in a
+            # partial-manual region), OFF for interpret kernels (which
+            # cannot trace under the check) — kernel_shard.py docstring
+            return shard_map_bh(
+                self.mesh, fn, *args, check_vma=(b != "pallas_interpret")
+            )
+        return fn(*args)
+
     # -- parallel forward ---------------------------------------------------
 
     def _sp_active(self) -> bool:
@@ -165,10 +184,17 @@ class Attention(nn.Module):
             if self.sp_local and self.causal:
                 from orion_tpu.parallel.sequence import sp_linear_attention_local
 
-                # the enclosing pipeline shard_map tracks vma (its transpose
-                # psums over pp), and pallas interpret mode can't trace under
-                # that check — run the XLA chunked form here; the pp×sp
-                # Pallas fast path needs real multi-chip hardware to validate
+                # Inside the pipeline the XLA chunked form is STRUCTURAL,
+                # not a temporary fallback: the pipeline shard_map is
+                # partial-manual ({pp, sp} manual, dp/fsdp/tp left to GSPMD
+                # so batch/tensor sharding compose), and jax's
+                # tpu_custom_call lowering rejects Mosaic kernels in any
+                # partial-manual region ("cannot be automatically
+                # partitioned") — verified by topology-AOT compiles against
+                # v5e:2x4. Every FULLY-manual composition does carry the
+                # kernels: plain GSPMD meshes via parallel/kernel_shard.py
+                # and sp-without-pp via sequence.py/ring.py (axis_names
+                # defaulted = all axes manual) — SP_PALLAS_AOT.json.
                 out = sp_linear_attention_local(
                     qf, kf, v, backend="xla", chunk=cfg.chunk
                 )
@@ -179,8 +205,11 @@ class Attention(nn.Module):
                     qf, kf, v, self.mesh, backend=cfg.backend, chunk=cfg.chunk
                 )
             elif self.causal:
-                out = linear_attention(
-                    qf, kf, v, backend=cfg.backend, chunk=cfg.chunk
+                out = self._kernel_bh(
+                    lambda a, b, c: linear_attention(
+                        a, b, c, backend=cfg.backend, chunk=cfg.chunk
+                    ),
+                    qf, kf, v,
                 )
             else:
                 km = None if mask is None else mask[:, None, :]
@@ -206,7 +235,18 @@ class Attention(nn.Module):
                 out = ring_attention(
                     q, k, v, self.mesh, causal=True, window=window
                 )
+            elif mask is None and self.causal:
+                out = self._kernel_bh(
+                    lambda a, b, c: softmax_attention(
+                        a, b, c, causal=True, window=window,
+                        backend=cfg.backend,
+                    ),
+                    q, k, v,
+                )
             else:
+                # masked / bidirectional (classifier): mask shapes don't fit
+                # the [B, H, ...] manualization — stays on the GSPMD path
+                # (xla backend; LRA configs are xla anyway)
                 am = None if mask is None else mask[:, None, None, :]
                 out = softmax_attention(
                     q, k, v, causal=self.causal, window=window,
@@ -222,8 +262,12 @@ class Attention(nn.Module):
         t = x.shape[-2]
         if self.layer_type == "linear":
             qf, kf = self._phi_map(q), self._phi_map(k)
-            out, (s, z) = linear_attention(
-                qf, kf, v, backend=cfg.backend, chunk=cfg.chunk, return_state=True
+            out, (s, z) = self._kernel_bh(
+                lambda a, b, c: linear_attention(
+                    a, b, c, backend=cfg.backend, chunk=cfg.chunk,
+                    return_state=True,
+                ),
+                qf, kf, v,
             )
             state = {"s": s, "z": z}
         else:
@@ -231,12 +275,21 @@ class Attention(nn.Module):
             qr = apply_rotary(q, ang)
             kr = apply_rotary(k, ang)
             if self.layer_type == "swa":
-                out = softmax_attention(
-                    qr, kr, v, causal=True, window=cfg.window, backend=cfg.backend
+                out = self._kernel_bh(
+                    lambda a, b, c: softmax_attention(
+                        a, b, c, causal=True, window=cfg.window,
+                        backend=cfg.backend,
+                    ),
+                    qr, kr, v,
                 )
                 state = _swa_cache_from_prefill(kr, v, t, cfg.window)
             else:
-                out = softmax_attention(qr, kr, v, causal=True, backend=cfg.backend)
+                out = self._kernel_bh(
+                    lambda a, b, c: softmax_attention(
+                        a, b, c, causal=True, backend=cfg.backend
+                    ),
+                    qr, kr, v,
+                )
                 smax = cfg.max_seq_len
                 pad = ((0, 0), (0, 0), (0, smax - t), (0, 0))
                 state = {"k": jnp.pad(kr, pad), "v": jnp.pad(v, pad)}

@@ -36,6 +36,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from orion_tpu.ops.pallas.causal_dot import _sds  # vma-carrying out_shape:
+# lets these kernels compose with shard_map(check_vma=True) bodies
+# (parallel/kernel_shard.py, parallel/pipeline.py) the same way the
+# causal_dot kernels do
+
 Array = jax.Array
 
 _NEG = -1e30
@@ -139,8 +144,8 @@ def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret):
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, nq * bq, dv), q.dtype),
-            jax.ShapeDtypeStruct((bh, nq * bq, 1), jnp.float32),
+            _sds((bh, nq * bq, dv), q.dtype, q),
+            _sds((bh, nq * bq, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -278,7 +283,7 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
         out_specs=pl.BlockSpec(
             (1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, nq * bq, d), q.dtype),
+        out_shape=_sds((bh, nq * bq, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, gp, lsep, deltap)
@@ -306,8 +311,8 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
             pl.BlockSpec((1, bk, dv), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, nk * bk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, nk * bk, dv), v.dtype),
+            _sds((bh, nk * bk, d), k.dtype, k),
+            _sds((bh, nk * bk, dv), v.dtype, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
