@@ -108,6 +108,8 @@ def sp_linear_attention(
 ) -> Array:
     """Global entry: q,k,v [B, H, T, D] with T sharded over ``axis``.
     Batch rides on (dp, fsdp); heads on tp."""
+    from orion_tpu.ops.dispatch import resolve
+
     spec = P(("dp", "fsdp"), "tp", axis, None)
     fn = shard_map(
         partial(
@@ -116,14 +118,15 @@ def sp_linear_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        # jax's pallas interpret-mode (the CPU test path) cannot run under
-        # check_vma=True: its internal dynamic_slice mixes varying operands
-        # with unvarying indices and jax itself says "as a temporary
-        # workaround pass check_vma=False" (hlo_interpreter.py). The kernel
-        # out_shapes do declare vma (ops/pallas/causal_dot.py::_sds), so
-        # flip this on once the interpreter is fixed; sp parity tests at
-        # 2/4/8 cover values+grads meanwhile.
-        check_vma=False,
+        # vma tracking ON except under pallas INTERPRET mode (the CPU test
+        # path), which cannot run under the check: its internal
+        # dynamic_slice mixes varying operands with unvarying indices and
+        # jax itself says "as a temporary workaround pass check_vma=False"
+        # (hlo_interpreter.py). Real kernels and the XLA form run fully
+        # checked — the kernel out_shapes declare vma
+        # (ops/pallas/causal_dot.py::_sds); sp parity tests at 2/4/8 cover
+        # the interpret path's values+grads meanwhile.
+        check_vma=(resolve(backend) != "pallas_interpret"),
     )
     return fn(q, k, v)
 
