@@ -53,6 +53,12 @@ class ModelConfig:
     # linear layers via the kv-state exclusive prefix (parallel/sequence.py),
     # softmax/swa layers via ring attention (parallel/ring.py)
     sequence_parallel: bool = False
+    # load-balanced striped ring (parallel/ring.py docstring) for FULL-causal
+    # softmax layers under sp: equal work on every ring step, removing the
+    # plain causal ring's ~2x critical-path imbalance, at the cost of one
+    # all_to_all per tensor. swa layers always keep the contiguous ring.
+    # Needs seq_len % sp^2 == 0.
+    ring_striped: bool = False
     # mixture-of-experts (models/moe.py): n_experts > 0 replaces the MLP of
     # every moe_period-th block with a routed expert MLP; expert weights
     # shard over the mesh's ep axis (parallel/sharding.py)

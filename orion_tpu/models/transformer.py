@@ -225,15 +225,22 @@ class Attention(nn.Module):
             q = apply_rotary(q, ang)
             k = apply_rotary(k, ang)
             window = cfg.window if self.layer_type == "swa" else None
+            # striped = the load-balanced ring (parallel/ring.py): full-
+            # causal softmax only; swa keeps the contiguous ring (striping
+            # a window loses its locality)
+            striped = cfg.ring_striped and window is None
             if self.sp_local and self.causal:
                 from orion_tpu.parallel.ring import ring_attention_local
 
-                out = ring_attention_local(q, k, v, causal=True, window=window)
+                out = ring_attention_local(
+                    q, k, v, causal=True, window=window, striped=striped
+                )
             elif sp:
                 from orion_tpu.parallel.ring import ring_attention
 
                 out = ring_attention(
-                    q, k, v, self.mesh, causal=True, window=window
+                    q, k, v, self.mesh, causal=True, window=window,
+                    striped=striped,
                 )
             elif mask is None and self.causal:
                 out = self._kernel_bh(

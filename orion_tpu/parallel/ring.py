@@ -14,6 +14,19 @@ Causal masking by block index: an incoming block j (vs my index i) is
 fully visible if j < i, diagonal (intra-block causal) if j == i, and
 skipped if j > i — skipped blocks still rotate (the ring must complete)
 but contribute zero compute via ``lax.cond``.
+
+That skip is load-IMBALANCED: shard 0 skips n-1 of its n steps while
+shard n-1 skips none, and the per-step ppermute chains each step onto the
+busiest shard — the causal ring's critical path is ~2× its average work.
+``striped=True`` fixes it with the striped layout (tokens dealt
+round-robin: global token g lives on shard g % n at local row g // n, via
+one in-ring all_to_all per tensor): every (i, j) block pair is then a
+near-triangular mask of the SAME size, so all shards do equal work on
+every step and no block is ever fully masked. Exact (softmax is
+permutation-invariant over keys; the online accumulator handles any
+arrival order); full-causal only (a sliding window striped across shards
+would touch every block and lose swa's locality — window keeps the
+contiguous ring).
 """
 
 from __future__ import annotations
@@ -49,6 +62,29 @@ def _block_attend(q, k, v, m, l, acc, scale, mask):
     return m_new, l_new, acc_new
 
 
+def _to_striped(x: Array, axis: str, n: int) -> Array:
+    """Contiguous shard layout -> striped: local row p ends up holding
+    global token p*n + i. One all_to_all; NOT self-inverse — the local
+    shuffle differs on the way back (``_from_striped``)."""
+    t_loc, d = x.shape[-2], x.shape[-1]
+    x4 = x.reshape(*x.shape[:-2], t_loc // n, n, d)
+    x4 = jnp.swapaxes(x4, -3, -2)  # [..., n(dest), t_loc/n, d]
+    y = lax.all_to_all(x4, axis, split_axis=x4.ndim - 3,
+                       concat_axis=x4.ndim - 3, tiled=False)
+    return y.reshape(*x.shape[:-2], t_loc, d)
+
+
+def _from_striped(x: Array, axis: str, n: int) -> Array:
+    """Inverse of ``_to_striped`` (the same exchange, inverse local
+    shuffle: received chunk from source s goes back to rows s*n-strided)."""
+    t_loc, d = x.shape[-2], x.shape[-1]
+    x4 = x.reshape(*x.shape[:-2], n, t_loc // n, d)
+    y = lax.all_to_all(x4, axis, split_axis=x4.ndim - 3,
+                       concat_axis=x4.ndim - 3, tiled=False)
+    y = jnp.swapaxes(y, -3, -2)  # [..., t_loc/n, n(src), d]
+    return y.reshape(*x.shape[:-2], t_loc, d)
+
+
 def ring_attention_local(
     q: Array,
     k: Array,
@@ -58,16 +94,32 @@ def ring_attention_local(
     causal: bool = True,
     window: Optional[int] = None,
     scale: Optional[float] = None,
+    striped: bool = False,
 ) -> Array:
     """shard_map body: q,k,v LOCAL [..., T/sp, D] shards; exact softmax
     attention over the full (global) sequence. ``window`` gives the
     sliding-window variant (query t sees keys (t-window, t]) so the 7B
-    hybrid's swa layers can ride the same ring."""
+    hybrid's swa layers can ride the same ring. ``striped`` switches to
+    the load-balanced striped layout (module docstring) — full-causal
+    only."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = lax.axis_size(axis)
     i = lax.axis_index(axis)
     t_loc = q.shape[-2]
+    if striped:
+        # real raises (not asserts): wrong numerics under -O would be silent
+        if not causal or window is not None:
+            raise ValueError(
+                "striped ring is the full-causal form; swa keeps the "
+                "contiguous ring (a striped window loses locality)"
+            )
+        if t_loc % n != 0:
+            raise ValueError(
+                f"striped ring needs T/sp divisible by sp (T_local={t_loc}, "
+                f"sp={n}) so the layout exchange tiles evenly"
+            )
+        q, k, v = (_to_striped(x, axis, n) for x in (q, k, v))
 
     local_row = jnp.arange(t_loc)[:, None]
     local_col = jnp.arange(t_loc)[None, :]
@@ -82,28 +134,41 @@ def ring_attention_local(
     def body(step, carry):
         k_blk, v_blk, m, l, acc = carry
         j = (i - step) % n  # origin shard of the block currently held
-        rows = i * t_loc + local_row  # absolute positions (traced via i, j)
-        cols = j * t_loc + local_col
-        mask = jnp.ones((t_loc, t_loc), bool)
-        if causal:
-            mask &= rows >= cols
-        if window is not None:
-            mask &= (rows - cols) < window
-        needs_mask = causal or window is not None
-
-        def attend(args):
-            m, l, acc = args
-            return _block_attend(
-                q, k_blk, v_blk, m, l, acc, scale, mask if needs_mask else None
+        if striped:
+            # striped layout: my row p holds global token p*n + i, the
+            # block's col c holds c*n + j -> attend iff c < p, plus the
+            # diagonal c == p when j <= i. Near-triangular EVERY step:
+            # equal work on every shard, nothing to skip.
+            mask = (local_col < local_row) | (
+                (local_col == local_row) & (j <= i)
             )
-
-        def skip(args):
-            return args
-
-        if needs_mask:
-            m, l, acc = lax.cond(jnp.any(mask), attend, skip, (m, l, acc))
+            m, l, acc = _block_attend(
+                q, k_blk, v_blk, m, l, acc, scale, mask
+            )
         else:
-            m, l, acc = attend((m, l, acc))
+            rows = i * t_loc + local_row  # absolute positions (via i, j)
+            cols = j * t_loc + local_col
+            mask = jnp.ones((t_loc, t_loc), bool)
+            if causal:
+                mask &= rows >= cols
+            if window is not None:
+                mask &= (rows - cols) < window
+            needs_mask = causal or window is not None
+
+            def attend(args):
+                m, l, acc = args
+                return _block_attend(
+                    q, k_blk, v_blk, m, l, acc, scale,
+                    mask if needs_mask else None,
+                )
+
+            def skip(args):
+                return args
+
+            if needs_mask:
+                m, l, acc = lax.cond(jnp.any(mask), attend, skip, (m, l, acc))
+            else:
+                m, l, acc = attend((m, l, acc))
 
         # rotate kv to the next device; after n-1 steps every block visited
         k_nxt = ppermute_shift(k_blk, axis)
@@ -112,7 +177,10 @@ def ring_attention_local(
 
     _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
     safe = jnp.where(l == 0.0, 1.0, l)
-    return (acc / safe).astype(q.dtype)
+    out = (acc / safe).astype(q.dtype)
+    if striped:
+        out = _from_striped(out, axis, n)
+    return out
 
 
 def ring_attention(
@@ -125,13 +193,14 @@ def ring_attention(
     causal: bool = True,
     window: Optional[int] = None,
     scale: Optional[float] = None,
+    striped: bool = False,
 ) -> Array:
     """Global entry: q,k,v [B, H, T, D] with T sharded over ``axis``."""
     spec = P(("dp", "fsdp"), "tp", axis, None)
     fn = shard_map(
         partial(
             ring_attention_local, axis=axis, causal=causal, window=window,
-            scale=scale,
+            scale=scale, striped=striped,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -73,6 +73,48 @@ def test_ring_attention_matches_softmax(causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_striped_ring_matches_softmax(sp):
+    """Load-balanced striped ring (layout all_to_all + per-step triangular
+    masks) is EXACT vs the global softmax reference at every sp width."""
+    mesh = _sp_mesh(sp)
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    b, h, t, d = 2, 2, 128, 8  # t/sp divisible by sp for all widths
+    q = jax.random.normal(k1, (b, h, t, d))
+    k = jax.random.normal(k2, (b, h, t, d))
+    v = jax.random.normal(k3, (b, h, t, d))
+    ref = softmax_attention_xla(q, k, v, causal=True)
+    got = ring_attention(q, k, v, mesh, causal=True, striped=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_striped_ring_grads():
+    mesh = _sp_mesh(2)
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(8), 4)
+    b, h, t, d = 1, 1, 16, 4
+    q = jax.random.normal(k1, (b, h, t, d))
+    k = jax.random.normal(k2, (b, h, t, d))
+    v = jax.random.normal(k3, (b, h, t, d))
+    w = jax.random.normal(k4, (b, h, t, d))
+    gr = jax.grad(lambda q, k, v: jnp.sum(softmax_attention_xla(q, k, v) * w),
+                  argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(
+        lambda q, k, v: jnp.sum(
+            ring_attention(q, k, v, mesh, striped=True) * w
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b_ in zip(gg, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-5, rtol=1e-5)
+
+
+def test_striped_ring_rejects_window():
+    mesh = _sp_mesh(2)
+    x = jnp.zeros((1, 1, 16, 4))
+    with pytest.raises(ValueError, match="striped"):
+        ring_attention(x, x, x, mesh, causal=True, window=4, striped=True)
+
+
 def test_ring_attention_grads():
     mesh = _sp_mesh(2)
     k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(4), 4)
@@ -210,9 +252,12 @@ def test_ring_attention_window():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_trainer_sequence_parallel_parity():
+@pytest.mark.parametrize("striped", [False, True], ids=["ring", "striped"])
+def test_trainer_sequence_parallel_parity(striped):
     """Full train step with sp=4 token sharding (SP linear attn + ring
-    softmax/swa inside the model) == single-device step."""
+    softmax/swa inside the model) == single-device step. ``striped`` runs
+    the softmax layer through the load-balanced striped ring (swa always
+    keeps the contiguous ring)."""
     from orion_tpu.training.data import SyntheticDataset
     from orion_tpu.training.trainer import TrainConfig, Trainer
 
@@ -221,7 +266,7 @@ def test_trainer_sequence_parallel_parity():
             name="sp_test", vocab_size=64, d_model=32, n_layers=3, n_heads=2,
             max_seq_len=64, dtype="float32", backend="xla",
             layer_types=("linear", "softmax", "swa"), window=6,
-            sequence_parallel=sp, chunk=8,
+            sequence_parallel=sp, chunk=8, ring_striped=striped,
         )
 
     mk = lambda m, sp: TrainConfig(  # noqa: E731
