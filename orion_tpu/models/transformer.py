@@ -240,7 +240,7 @@ class Attention(nn.Module):
 
                 out = ring_attention(
                     q, k, v, self.mesh, causal=True, window=window,
-                    striped=striped,
+                    striped=striped, backend=cfg.backend,
                 )
             elif mask is None and self.causal:
                 out = self._kernel_bh(

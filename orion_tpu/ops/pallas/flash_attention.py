@@ -46,21 +46,26 @@ Array = jax.Array
 _NEG = -1e30
 
 
-def _tile_mask(rows: Array, cols: Array, causal: bool, window: Optional[int], t_k: int):
-    """Boolean (Bq, Bk) tile of the structural mask at absolute row/col ids."""
+def _tile_mask(rows: Array, cols: Array, causal: bool, window: Optional[int],
+               t_k: int, shift: int = 0):
+    """Boolean (Bq, Bk) tile of the structural mask at absolute row/col ids.
+    ``shift`` strengthens the causal bound to rows >= cols + shift:
+    shift=1 is the STRICT triangle a striped ring block needs when the kv
+    stripe's phase is ahead of the query stripe's (parallel/ring.py)."""
     m = cols < t_k  # mask out key padding
     if causal:
-        m &= rows >= cols
+        m &= rows >= cols + shift
     if window is not None:
         m &= (rows - cols) < window
     return m
 
 
-def _skip_tile(qi, ki, bq, bk, causal, window):
+def _skip_tile(qi, ki, bq, bk, causal, window, shift: int = 0):
     """True if tile (qi, ki) is entirely masked (static-shape predicate)."""
     skip = jnp.bool_(False)
     if causal:
-        skip |= ki * bk > qi * bq + (bq - 1)  # first key row past last query
+        # first key row past the last query it may attend to
+        skip |= ki * bk > qi * bq + (bq - 1) - shift
     if window is not None:
         skip |= (qi * bq) - (ki * bk + bk - 1) >= window  # band entirely left
     return skip
@@ -79,7 +84,7 @@ def _rowscol(qi, ki, bq, bk):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, window, t_k, bq, bk, nk,
+    *, scale, causal, window, shift, t_k, bq, bk, nk,
 ):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
@@ -89,7 +94,7 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window)))
+    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift)))
     def _():
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0],
@@ -97,7 +102,7 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # (Bq, Bk)
         rows, cols = _rowscol(qi, ki, bq, bk)
-        s = jnp.where(_tile_mask(rows, cols, causal, window, t_k), s, _NEG)
+        s = jnp.where(_tile_mask(rows, cols, causal, window, t_k, shift), s, _NEG)
 
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -117,7 +122,7 @@ def _fwd_kernel(
         lse_ref[0] = m_scr[:] + jnp.log(safe)  # (Bq, 1)
 
 
-def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret):
+def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret, shift=0):
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     dv = v.shape[-1]
@@ -128,7 +133,7 @@ def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret):
     nq, nk = qp.shape[1] // bq, kp.shape[1] // bk
 
     kern = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, window=window,
+        _fwd_kernel, scale=scale, causal=causal, window=window, shift=shift,
         t_k=t_k, bq=bq, bk=bk, nk=nk,
     )
     out, lse = pl.pallas_call(
@@ -164,7 +169,7 @@ def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret):
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale, causal, window, t_k, bq, bk, nk,
+    *, scale, causal, window, shift, t_k, bq, bk, nk,
 ):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
@@ -172,7 +177,7 @@ def _dq_kernel(
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window)))
+    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift)))
     def _():
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0],
@@ -180,7 +185,7 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
         rows, cols = _rowscol(qi, ki, bq, bk)
-        mask = _tile_mask(rows, cols, causal, window, t_k)
+        mask = _tile_mask(rows, cols, causal, window, t_k, shift)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)  # lse: (Bq, 1)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
@@ -200,7 +205,7 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale, causal, window, t_k, bq, bk, nq,
+    *, scale, causal, window, shift, t_k, bq, bk, nq,
 ):
     ki, qi = pl.program_id(1), pl.program_id(2)
 
@@ -209,7 +214,7 @@ def _dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window)))
+    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift)))
     def _():
         # q-major (Bq, Bk) tile; k-side grads via contraction over the q dim
         s = jax.lax.dot_general(
@@ -218,7 +223,7 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
         rows, cols = _rowscol(qi, ki, bq, bk)
-        mask = _tile_mask(rows, cols, causal, window, t_k)
+        mask = _tile_mask(rows, cols, causal, window, t_k, shift)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do_ref[0].astype(jnp.float32),
@@ -243,13 +248,19 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpret):
+def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpret,
+                    shift=0, dlse=None):
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     dv = v.shape[-1]
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
     )  # (BH, Tq, 1)
+    if dlse is not None:
+        # lse cotangent (flash_attention_lse): dS_ij = P̂_ij (dP_ij − Δ_i +
+        # dlse_i), since ∂lse_i/∂S_ij = P̂_ij — folds into the delta column,
+        # so the kernels themselves are unchanged
+        delta = delta - dlse.astype(jnp.float32)
 
     pq, pk = (-t_q) % bq, (-t_k) % bk
     padq = lambda x: jnp.pad(x, ((0, 0), (0, pq), (0, 0))) if pq else x  # noqa: E731
@@ -266,7 +277,7 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
     col_spec_q = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
 
     dq_kern = functools.partial(
-        _dq_kernel, scale=scale, causal=causal, window=window,
+        _dq_kernel, scale=scale, causal=causal, window=window, shift=shift,
         t_k=t_k, bq=bq, bk=bk, nk=nk,
     )
     dq = pl.pallas_call(
@@ -292,7 +303,7 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
         (1, bq, 1), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM
     )
     dkv_kern = functools.partial(
-        _dkv_kernel, scale=scale, causal=causal, window=window,
+        _dkv_kernel, scale=scale, causal=causal, window=window, shift=shift,
         t_k=t_k, bq=bq, bk=bk, nq=nq,
     )
     dk, dv_ = pl.pallas_call(
@@ -328,26 +339,40 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, scale, causal, window, bq, bk, interpret):
-    out, _ = _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret)
-    return out
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, scale, causal, window, shift, bq, bk, interpret):
+    return _flash_fwd_flat(
+        q, k, v, scale, causal, window, bq, bk, interpret, shift=shift
+    )
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, window, bq, bk, interpret):
-    out, lse = _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_lse_vjp_fwd(q, k, v, scale, causal, window, shift, bq, bk, interpret):
+    out, lse = _flash_fwd_flat(
+        q, k, v, scale, causal, window, bq, bk, interpret, shift=shift
+    )
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(scale, causal, window, bq, bk, interpret, res, g):
+def _flash_lse_vjp_bwd(scale, causal, window, shift, bq, bk, interpret, res, gs):
     q, k, v, out, lse = res
+    g, dlse = gs
     dq, dk, dv = _flash_bwd_flat(
-        q, k, v, out, lse, g.astype(q.dtype), scale, causal, window, bq, bk, interpret
+        q, k, v, out, lse, g.astype(q.dtype), scale, causal, window, bq, bk,
+        interpret, shift=shift, dlse=dlse,
     )
     return dq, dk, dv
 
 
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def _blocks(q, block_q, block_k, t_q, t_k):
+    # clamp to the sequence length, then round up to the TPU sublane tile
+    # (8 rows fp32, 16 bf16) — Mosaic may reject/deoptimize ragged blocks;
+    # the existing tail padding + t_k masking absorbs the overshoot
+    tile = 16 if q.dtype == jnp.bfloat16 else 8
+    rup = lambda x: -(-x // tile) * tile  # noqa: E731
+    return rup(min(block_q, max(t_q, 8))), rup(min(block_k, max(t_k, 8)))
 
 
 def flash_attention(
@@ -371,20 +396,56 @@ def flash_attention(
     bh = 1
     for s in batch_shape:
         bh *= s
-    # clamp to the sequence length, then round up to the TPU sublane tile
-    # (8 rows fp32, 16 bf16) — Mosaic may reject/deoptimize ragged blocks;
-    # the existing tail padding + t_k masking absorbs the overshoot
-    tile = 16 if q.dtype == jnp.bfloat16 else 8
-    rup = lambda x: -(-x // tile) * tile  # noqa: E731
-    bq = rup(min(block_q, max(t_q, 8)))
-    bk = rup(min(block_k, max(t_k, 8)))
-    out = _flash(
+    bq, bk = _blocks(q, block_q, block_k, t_q, t_k)
+    # one custom_vjp path serves both entries: the dropped lse output is
+    # DCE'd by XLA and its zero cotangent costs one subtraction in the bwd
+    out, _ = _flash_lse(
         q.reshape(bh, t_q, d),
         k.reshape(bh, t_k, d),
         v.reshape(bh, t_k, dv),
-        float(scale), causal, window, bq, bk, interpret,
+        float(scale), causal, window, 0, bq, bk, interpret,
     )
     return out.reshape(*batch_shape, t_q, dv)
 
 
-__all__ = ["flash_attention"]
+def flash_attention_lse(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+    shift: int = 0,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """Flash attention that ALSO returns the row log-sum-exp
+    ([..., T, 1] fp32) and is differentiable in both outputs — the block
+    primitive for cross-shard online-softmax merges (parallel/ring.py):
+    merging partial results needs lse, and the merged output's gradient
+    flows through it (∂lse/∂S = P̂, folded into the backward's delta
+    column). ``shift=1`` strengthens causal to the strict triangle."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    batch_shape = q.shape[:-2]
+    t_q, d = q.shape[-2:]
+    t_k, dv = k.shape[-2], v.shape[-1]
+    bh = 1
+    for s in batch_shape:
+        bh *= s
+    bq, bk = _blocks(q, block_q, block_k, t_q, t_k)
+    out, lse = _flash_lse(
+        q.reshape(bh, t_q, d),
+        k.reshape(bh, t_k, d),
+        v.reshape(bh, t_k, dv),
+        float(scale), causal, window, shift, bq, bk, interpret,
+    )
+    return (
+        out.reshape(*batch_shape, t_q, dv),
+        lse.reshape(*batch_shape, t_q, 1),
+    )
+
+
+__all__ = ["flash_attention", "flash_attention_lse"]

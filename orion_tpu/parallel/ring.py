@@ -95,13 +95,21 @@ def ring_attention_local(
     window: Optional[int] = None,
     scale: Optional[float] = None,
     striped: bool = False,
+    backend: str = "xla",
 ) -> Array:
     """shard_map body: q,k,v LOCAL [..., T/sp, D] shards; exact softmax
     attention over the full (global) sequence. ``window`` gives the
     sliding-window variant (query t sees keys (t-window, t]) so the 7B
     hybrid's swa layers can ride the same ring. ``striped`` switches to
     the load-balanced striped layout (module docstring) — full-causal
-    only."""
+    only.
+
+    ``backend="pallas"`` (striped only) runs each per-step block through
+    the flash kernel (ops/pallas/flash_attention.py::flash_attention_lse —
+    legal here: the enclosing sp shard_map is fully manual, so Mosaic
+    lowers) and merges blocks by log-sum-exp; gradients flow through the
+    kernel's custom VJP including the lse cotangent. The default XLA body
+    is the einsum online-softmax fold."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = lax.axis_size(axis)
@@ -121,6 +129,11 @@ def ring_attention_local(
             )
         q, k, v = (_to_striped(x, axis, n) for x in (q, k, v))
 
+    from orion_tpu.ops.dispatch import resolve
+
+    b = resolve(backend)
+    use_kernel = striped and b in ("pallas", "pallas_interpret")
+
     local_row = jnp.arange(t_loc)[:, None]
     local_col = jnp.arange(t_loc)[None, :]
 
@@ -134,7 +147,39 @@ def ring_attention_local(
     def body(step, carry):
         k_blk, v_blk, m, l, acc = carry
         j = (i - step) % n  # origin shard of the block currently held
-        if striped:
+        if striped and use_kernel:
+            # flash-kernel block + lse merge. The causal shift (strict
+            # triangle when the kv stripe's phase is ahead) must be STATIC
+            # for the kernel's tile-skip predicates, so both variants are
+            # compiled and lax.cond picks per step — still one kernel
+            # execution per step.
+            from orion_tpu.ops.pallas.flash_attention import (
+                flash_attention_lse,
+            )
+
+            def blk(shift):
+                def f(_):
+                    return flash_attention_lse(
+                        q, k_blk, v_blk, causal=True, shift=shift,
+                        scale=scale, interpret=(b == "pallas_interpret"),
+                    )
+
+                return f
+
+            o_j, lse_j = lax.cond(j <= i, blk(0), blk(1), None)
+            m_new = jnp.maximum(m, lse_j)
+            alpha = jnp.exp(m - m_new)
+            # empty blocks report lse=-1e30; the explicit where (rather
+            # than trusting exp(lse - m_new) to underflow) keeps the merge
+            # correct even while the running m is still at its -1e30 init,
+            # i.e. independent of the ring schedule's visit order
+            w_j = jnp.where(
+                lse_j <= _NEG / 2, 0.0, jnp.exp(lse_j - m_new)
+            )
+            l = l * alpha + w_j
+            acc = acc * alpha + o_j.astype(jnp.float32) * w_j
+            m = m_new
+        elif striped:
             # striped layout: my row p holds global token p*n + i, the
             # block's col c holds c*n + j -> attend iff c < p, plus the
             # diagonal c == p when j <= i. Near-triangular EVERY step:
@@ -194,17 +239,23 @@ def ring_attention(
     window: Optional[int] = None,
     scale: Optional[float] = None,
     striped: bool = False,
+    backend: str = "xla",
 ) -> Array:
     """Global entry: q,k,v [B, H, T, D] with T sharded over ``axis``."""
+    from orion_tpu.ops.dispatch import resolve
+
     spec = P(("dp", "fsdp"), "tp", axis, None)
     fn = shard_map(
         partial(
             ring_attention_local, axis=axis, causal=causal, window=window,
-            scale=scale, striped=striped,
+            scale=scale, striped=striped, backend=backend,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # vma on except under interpret-mode kernels, which cannot trace
+        # under the check (same constraint and reasoning as sequence.py)
+        check_vma=(resolve(backend) != "pallas_interpret"),
     )
     return fn(q, k, v)
 

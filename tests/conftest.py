@@ -46,6 +46,8 @@ _SLOW = {
     "test_pipeline.py::test_trainer_pipeline_parallel_parity",
     "test_sharding.py::test_trainer_sequence_parallel_parity[ring]",
     "test_sharding.py::test_trainer_sequence_parallel_parity[striped]",
+    "test_sharding.py::test_striped_ring_flash_kernel_path[2]",
+    "test_sharding.py::test_striped_ring_flash_kernel_path[4]",
     "test_training.py::test_checkpoint_restores_across_meshes",
     "test_sharding.py::test_sp_linear_attention_grads",
     "test_moe.py::TestMoETraining::test_trainer_step_and_loss_includes_aux",

@@ -83,11 +83,14 @@ def test_topology_aot_pallas_under_sp():
     pins the pipeline body to the XLA forms."""
     mc = MeshConfig(dp=2, sp=4)
     mesh = _topo_mesh_or_skip(mc)
+    # softmax layer: the STRIPED ring with flash-kernel blocks + lse merge;
+    # linear layers: the fused-parts sp kernel; the swa layer rides the
+    # contiguous (xla-body) windowed ring — keeping its sp lowering covered
     model = ModelConfig(
         name="sp_pallas", vocab_size=512, d_model=256, n_layers=4,
-        n_heads=4, layer_types=hybrid_pattern(4, period=2), window=256,
-        max_seq_len=1024, dtype="bfloat16", backend="pallas", remat=True,
-        sequence_parallel=True,
+        n_heads=4, layer_types=("softmax", "linear", "swa", "linear"),
+        window=256, max_seq_len=1024, dtype="bfloat16", backend="pallas",
+        remat=True, sequence_parallel=True, ring_striped=True,
     )
     cfg = TrainConfig(model=model, batch_size=4, seq_len=1024, mesh=mc)
     rep = plan(cfg, compile_step=True, mesh=mesh)
@@ -95,6 +98,7 @@ def test_topology_aot_pallas_under_sp():
     cc = rep["collectives"]
     assert cc["mosaic_kernels"] > 0, cc
     assert cc["collective-permute"] > 0, cc  # sp state prefix / ring hops
+    assert cc["all-to-all"] > 0, cc  # the striped layout exchange
 
 
 def test_scaled_hybrid_compiles_with_collectives():

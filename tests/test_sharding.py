@@ -108,6 +108,38 @@ def test_striped_ring_grads():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.parametrize("sp", [2, 4])
+def test_striped_ring_flash_kernel_path(sp):
+    """Striped ring with per-step flash-kernel blocks + lse merge
+    (interpret mode) == global softmax, values AND grads — the grads
+    exercise the kernel VJP's lse-cotangent path (the merged output
+    differentiates through each block's log-sum-exp)."""
+    mesh = _sp_mesh(sp)
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(11), 4)
+    b, h, t, d = 1, 2, 64, 8
+    q = jax.random.normal(k1, (b, h, t, d))
+    k = jax.random.normal(k2, (b, h, t, d))
+    v = jax.random.normal(k3, (b, h, t, d))
+    ref = softmax_attention_xla(q, k, v, causal=True)
+    got = ring_attention(
+        q, k, v, mesh, causal=True, striped=True, backend="pallas_interpret"
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+    w = jax.random.normal(k4, v.shape)
+    gr = jax.grad(lambda q, k, v: jnp.sum(softmax_attention_xla(q, k, v) * w),
+                  argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(
+        lambda q, k, v: jnp.sum(
+            ring_attention(q, k, v, mesh, striped=True,
+                           backend="pallas_interpret") * w
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b_ in zip(gg, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4, rtol=1e-4)
+
+
 def test_striped_ring_rejects_window():
     mesh = _sp_mesh(2)
     x = jnp.zeros((1, 1, 16, 4))
