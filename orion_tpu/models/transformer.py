@@ -236,12 +236,27 @@ class Attention(nn.Module):
                     q, k, v, causal=True, window=window, striped=striped
                 )
             elif sp:
-                from orion_tpu.parallel.ring import ring_attention
-
-                out = ring_attention(
-                    q, k, v, self.mesh, causal=True, window=window,
-                    striped=striped, backend=cfg.backend,
+                from orion_tpu.ops.dispatch import resolve
+                from orion_tpu.parallel.ring import (
+                    ring_attention,
+                    swa_halo_attention,
                 )
+
+                if window is not None and resolve(cfg.backend).startswith(
+                    "pallas"
+                ):
+                    # swa under sp with kernels: halo exchange (O(h)
+                    # ppermutes + flash blocks at static q_offset) beats
+                    # the n-step ring — ring.py::swa_halo_attention_local
+                    out = swa_halo_attention(
+                        q, k, v, self.mesh, window=window,
+                        backend=cfg.backend,
+                    )
+                else:
+                    out = ring_attention(
+                        q, k, v, self.mesh, causal=True, window=window,
+                        striped=striped, backend=cfg.backend,
+                    )
             elif mask is None and self.causal:
                 out = self._kernel_bh(
                     lambda a, b, c: softmax_attention(

@@ -47,12 +47,13 @@ _NEG = -1e30
 
 
 def _tile_mask(rows: Array, cols: Array, causal: bool, window: Optional[int],
-               t_k: int, shift: int = 0):
+               t_k: int, shift: int = 0, q_offset: int = 0):
     """Boolean (Bq, Bk) tile of the structural mask at absolute row/col ids.
     ``shift`` strengthens the causal bound to rows >= cols + shift:
     shift=1 is the STRICT triangle a striped ring block needs when the kv
     stripe's phase is ahead of the query stripe's (parallel/ring.py)."""
     m = cols < t_k  # mask out key padding
+    rows = rows + q_offset
     if causal:
         m &= rows >= cols + shift
     if window is not None:
@@ -60,14 +61,16 @@ def _tile_mask(rows: Array, cols: Array, causal: bool, window: Optional[int],
     return m
 
 
-def _skip_tile(qi, ki, bq, bk, causal, window, shift: int = 0):
+def _skip_tile(qi, ki, bq, bk, causal, window, shift: int = 0,
+               q_offset: int = 0):
     """True if tile (qi, ki) is entirely masked (static-shape predicate)."""
     skip = jnp.bool_(False)
     if causal:
         # first key row past the last query it may attend to
-        skip |= ki * bk > qi * bq + (bq - 1) - shift
+        skip |= ki * bk > qi * bq + q_offset + (bq - 1) - shift
     if window is not None:
-        skip |= (qi * bq) - (ki * bk + bk - 1) >= window  # band entirely left
+        # band entirely left of the tile
+        skip |= (qi * bq + q_offset) - (ki * bk + bk - 1) >= window
     return skip
 
 
@@ -84,7 +87,7 @@ def _rowscol(qi, ki, bq, bk):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, window, shift, t_k, bq, bk, nk,
+    *, scale, causal, window, shift, q_offset, t_k, bq, bk, nk,
 ):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
@@ -94,7 +97,7 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift)))
+    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift, q_offset)))
     def _():
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0],
@@ -102,7 +105,7 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # (Bq, Bk)
         rows, cols = _rowscol(qi, ki, bq, bk)
-        s = jnp.where(_tile_mask(rows, cols, causal, window, t_k, shift), s, _NEG)
+        s = jnp.where(_tile_mask(rows, cols, causal, window, t_k, shift, q_offset), s, _NEG)
 
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -122,7 +125,8 @@ def _fwd_kernel(
         lse_ref[0] = m_scr[:] + jnp.log(safe)  # (Bq, 1)
 
 
-def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret, shift=0):
+def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret, shift=0,
+                    q_offset=0):
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     dv = v.shape[-1]
@@ -134,6 +138,7 @@ def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret, shift=0):
 
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, window=window, shift=shift,
+        q_offset=q_offset,
         t_k=t_k, bq=bq, bk=bk, nk=nk,
     )
     out, lse = pl.pallas_call(
@@ -169,7 +174,7 @@ def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret, shift=0):
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale, causal, window, shift, t_k, bq, bk, nk,
+    *, scale, causal, window, shift, q_offset, t_k, bq, bk, nk,
 ):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
@@ -177,7 +182,7 @@ def _dq_kernel(
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift)))
+    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift, q_offset)))
     def _():
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0],
@@ -185,7 +190,7 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
         rows, cols = _rowscol(qi, ki, bq, bk)
-        mask = _tile_mask(rows, cols, causal, window, t_k, shift)
+        mask = _tile_mask(rows, cols, causal, window, t_k, shift, q_offset)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)  # lse: (Bq, 1)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
@@ -205,7 +210,7 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale, causal, window, shift, t_k, bq, bk, nq,
+    *, scale, causal, window, shift, q_offset, t_k, bq, bk, nq,
 ):
     ki, qi = pl.program_id(1), pl.program_id(2)
 
@@ -214,7 +219,7 @@ def _dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift)))
+    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift, q_offset)))
     def _():
         # q-major (Bq, Bk) tile; k-side grads via contraction over the q dim
         s = jax.lax.dot_general(
@@ -223,7 +228,7 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
         rows, cols = _rowscol(qi, ki, bq, bk)
-        mask = _tile_mask(rows, cols, causal, window, t_k, shift)
+        mask = _tile_mask(rows, cols, causal, window, t_k, shift, q_offset)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do_ref[0].astype(jnp.float32),
@@ -249,7 +254,7 @@ def _dkv_kernel(
 
 
 def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpret,
-                    shift=0, dlse=None):
+                    shift=0, dlse=None, q_offset=0):
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     dv = v.shape[-1]
@@ -278,6 +283,7 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
 
     dq_kern = functools.partial(
         _dq_kernel, scale=scale, causal=causal, window=window, shift=shift,
+        q_offset=q_offset,
         t_k=t_k, bq=bq, bk=bk, nk=nk,
     )
     dq = pl.pallas_call(
@@ -304,6 +310,7 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
     )
     dkv_kern = functools.partial(
         _dkv_kernel, scale=scale, causal=causal, window=window, shift=shift,
+        q_offset=q_offset,
         t_k=t_k, bq=bq, bk=bk, nq=nq,
     )
     dk, dv_ = pl.pallas_call(
@@ -339,26 +346,31 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash_lse(q, k, v, scale, causal, window, shift, bq, bk, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash_lse(q, k, v, scale, causal, window, shift, q_offset, bq, bk,
+               interpret):
     return _flash_fwd_flat(
-        q, k, v, scale, causal, window, bq, bk, interpret, shift=shift
+        q, k, v, scale, causal, window, bq, bk, interpret, shift=shift,
+        q_offset=q_offset,
     )
 
 
-def _flash_lse_vjp_fwd(q, k, v, scale, causal, window, shift, bq, bk, interpret):
+def _flash_lse_vjp_fwd(q, k, v, scale, causal, window, shift, q_offset, bq,
+                       bk, interpret):
     out, lse = _flash_fwd_flat(
-        q, k, v, scale, causal, window, bq, bk, interpret, shift=shift
+        q, k, v, scale, causal, window, bq, bk, interpret, shift=shift,
+        q_offset=q_offset,
     )
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_vjp_bwd(scale, causal, window, shift, bq, bk, interpret, res, gs):
+def _flash_lse_vjp_bwd(scale, causal, window, shift, q_offset, bq, bk,
+                       interpret, res, gs):
     q, k, v, out, lse = res
     g, dlse = gs
     dq, dk, dv = _flash_bwd_flat(
         q, k, v, out, lse, g.astype(q.dtype), scale, causal, window, bq, bk,
-        interpret, shift=shift, dlse=dlse,
+        interpret, shift=shift, dlse=dlse, q_offset=q_offset,
     )
     return dq, dk, dv
 
@@ -403,7 +415,7 @@ def flash_attention(
         q.reshape(bh, t_q, d),
         k.reshape(bh, t_k, d),
         v.reshape(bh, t_k, dv),
-        float(scale), causal, window, 0, bq, bk, interpret,
+        float(scale), causal, window, 0, 0, bq, bk, interpret,
     )
     return out.reshape(*batch_shape, t_q, dv)
 
@@ -416,6 +428,7 @@ def flash_attention_lse(
     causal: bool = True,
     window: Optional[int] = None,
     shift: int = 0,
+    q_offset: int = 0,
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 512,
@@ -440,7 +453,7 @@ def flash_attention_lse(
         q.reshape(bh, t_q, d),
         k.reshape(bh, t_k, d),
         v.reshape(bh, t_k, dv),
-        float(scale), causal, window, shift, bq, bk, interpret,
+        float(scale), causal, window, shift, q_offset, bq, bk, interpret,
     )
     return (
         out.reshape(*batch_shape, t_q, dv),

@@ -62,6 +62,87 @@ def _block_attend(q, k, v, m, l, acc, scale, mask):
     return m_new, l_new, acc_new
 
 
+def _lse_merge(m, l, acc, o_j, lse_j):
+    """Fold one flash block result (o_j normalized within block, lse_j)
+    into the running (m, l, acc) online-softmax accumulator. The explicit
+    empty-block guard (rather than trusting exp(lse - m_new) to
+    underflow) keeps the merge correct even while the running m is still
+    at its -1e30 init — i.e. independent of block visit order."""
+    m_new = jnp.maximum(m, lse_j)
+    alpha = jnp.exp(m - m_new)
+    w_j = jnp.where(lse_j <= _NEG / 2, 0.0, jnp.exp(lse_j - m_new))
+    l = l * alpha + w_j
+    acc = acc * alpha + o_j.astype(jnp.float32) * w_j
+    return m_new, l, acc
+
+
+def swa_halo_attention_local(
+    q: Array,
+    k: Array,
+    v: Array,
+    axis: str = "sp",
+    *,
+    window: int,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> Array:
+    """Sliding-window attention over sp-sharded tokens as a HALO exchange,
+    not a ring: a query only reaches W-1 tokens back, so shard i needs the
+    previous h = ceil((W-1) / T_local) blocks, nothing more. Gather them with
+    h neighbor ppermutes and run h+1 flash kernel calls — the local
+    causal+window block plus one per halo block at STATIC query offset
+    m*T_local (ops/pallas/flash_attention.py q_offset) — merged by
+    log-sum-exp. Cost: O(h) collectives per layer instead of the ring's
+    n, and every matmul is a Mosaic kernel (this runs inside the fully
+    manual sp shard_map).
+
+    Shards with fewer than h predecessors skip the missing blocks via
+    lax.cond (their contribution is exactly empty), so no wrapped garbage
+    is ever read. Exact vs the global windowed softmax; differentiable
+    (kernel VJP incl. the lse cotangent).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    from orion_tpu.ops.pallas.flash_attention import flash_attention_lse
+
+    n = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    t_loc = q.shape[-2]
+    # a query reaches back window-1 tokens, so the deepest halo block is
+    # ceil((window-1)/t_loc) — W % t_loc == 1 (incl. W=1) needs one FEWER
+    # block than ceil(W/t_loc) would fetch
+    h = min(-(-(window - 1) // t_loc), n - 1)
+
+    o, lse = flash_attention_lse(
+        q, k, v, causal=True, window=window, scale=scale, interpret=interpret
+    )
+    m_run = jnp.full_like(lse, _NEG)
+    l = jnp.zeros_like(lse)
+    acc = jnp.zeros_like(o, dtype=jnp.float32)
+    m_run, l, acc = _lse_merge(m_run, l, acc, o, lse)
+
+    k_m, v_m = k, v
+    for m in range(1, h + 1):
+        # after m shifts this holds the block of shard i - m
+        k_m = ppermute_shift(k_m, axis)
+        v_m = ppermute_shift(v_m, axis)
+
+        def blk(_, k_blk=k_m, v_blk=v_m, off=m * t_loc):
+            return flash_attention_lse(
+                q, k_blk, v_blk, causal=True, window=window,
+                q_offset=off, scale=scale, interpret=interpret,
+            )
+
+        def empty(_):
+            return jnp.zeros_like(o), jnp.full_like(lse, _NEG)
+
+        o_m, lse_m = lax.cond(i >= m, blk, empty, None)
+        m_run, l, acc = _lse_merge(m_run, l, acc, o_m, lse_m)
+
+    safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe).astype(q.dtype)
+
+
 def _to_striped(x: Array, axis: str, n: int) -> Array:
     """Contiguous shard layout -> striped: local row p ends up holding
     global token p*n + i. One all_to_all; NOT self-inverse — the local
@@ -167,18 +248,7 @@ def ring_attention_local(
                 return f
 
             o_j, lse_j = lax.cond(j <= i, blk(0), blk(1), None)
-            m_new = jnp.maximum(m, lse_j)
-            alpha = jnp.exp(m - m_new)
-            # empty blocks report lse=-1e30; the explicit where (rather
-            # than trusting exp(lse - m_new) to underflow) keeps the merge
-            # correct even while the running m is still at its -1e30 init,
-            # i.e. independent of the ring schedule's visit order
-            w_j = jnp.where(
-                lse_j <= _NEG / 2, 0.0, jnp.exp(lse_j - m_new)
-            )
-            l = l * alpha + w_j
-            acc = acc * alpha + o_j.astype(jnp.float32) * w_j
-            m = m_new
+            m, l, acc = _lse_merge(m, l, acc, o_j, lse_j)
         elif striped:
             # striped layout: my row p holds global token p*n + i, the
             # block's col c holds c*n + j -> attend iff c < p, plus the
@@ -260,4 +330,46 @@ def ring_attention(
     return fn(q, k, v)
 
 
-__all__ = ["ring_attention", "ring_attention_local"]
+def swa_halo_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    mesh: Mesh,
+    *,
+    axis: str = "sp",
+    window: int,
+    scale: Optional[float] = None,
+    backend: str = "auto",
+) -> Array:
+    """Global entry for the halo form of sp sliding-window attention:
+    q,k,v [B, H, T, D] with T sharded over ``axis``. Non-pallas resolved
+    backends (xla, or auto off-TPU) delegate to the windowed contiguous
+    ring — the halo body is kernel-only."""
+    from orion_tpu.ops.dispatch import resolve
+
+    b = resolve(backend)
+    if not b.startswith("pallas"):
+        return ring_attention(
+            q, k, v, mesh, axis=axis, causal=True, window=window,
+            scale=scale, backend=b,
+        )
+    spec = P(("dp", "fsdp"), "tp", axis, None)
+    fn = shard_map(
+        partial(
+            swa_halo_attention_local, axis=axis, window=window, scale=scale,
+            interpret=(b == "pallas_interpret"),
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=(b != "pallas_interpret"),
+    )
+    return fn(q, k, v)
+
+
+__all__ = [
+    "ring_attention",
+    "ring_attention_local",
+    "swa_halo_attention",
+    "swa_halo_attention_local",
+]

@@ -48,6 +48,9 @@ _SLOW = {
     "test_sharding.py::test_trainer_sequence_parallel_parity[striped]",
     "test_sharding.py::test_striped_ring_flash_kernel_path[2]",
     "test_sharding.py::test_striped_ring_flash_kernel_path[4]",
+    "test_sharding.py::test_swa_halo_matches_windowed_softmax[2-32-5]",
+    "test_sharding.py::test_swa_halo_matches_windowed_softmax[4-64-20]",
+    "test_sharding.py::test_swa_halo_matches_windowed_softmax[4-64-16]",
     "test_training.py::test_checkpoint_restores_across_meshes",
     "test_sharding.py::test_sp_linear_attention_grads",
     "test_moe.py::TestMoETraining::test_trainer_step_and_loss_includes_aux",

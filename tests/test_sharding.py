@@ -272,6 +272,48 @@ def test_sharded_generate_kernel_manualized():
     np.testing.assert_array_equal(got, ref)
 
 
+@pytest.mark.parametrize("sp,t,window", [
+    (2, 32, 5),    # h=1: window inside one local block
+    (4, 64, 20),   # h=2: halo spans two neighbor blocks
+    (4, 64, 16),   # h=1 exactly (window == t_loc)
+])
+def test_swa_halo_matches_windowed_softmax(sp, t, window):
+    """Halo-form sp sliding-window attention (h neighbor ppermutes +
+    flash blocks at static q_offset, lse-merged) == global windowed
+    softmax, values and grads."""
+    from orion_tpu.parallel.ring import swa_halo_attention
+
+    mesh = _sp_mesh(sp)
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(13), 4)
+    b, h, d = 1, 2, 8
+    q = jax.random.normal(k1, (b, h, t, d))
+    k = jax.random.normal(k2, (b, h, t, d))
+    v = jax.random.normal(k3, (b, h, t, d))
+    ref = softmax_attention_xla(q, k, v, causal=True, window=window)
+    got = swa_halo_attention(
+        q, k, v, mesh, window=window, backend="pallas_interpret"
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+    w = jax.random.normal(k4, v.shape)
+    gr = jax.grad(
+        lambda q, k, v: jnp.sum(
+            softmax_attention_xla(q, k, v, causal=True, window=window) * w
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gg = jax.grad(
+        lambda q, k, v: jnp.sum(
+            swa_halo_attention(
+                q, k, v, mesh, window=window, backend="pallas_interpret"
+            ) * w
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b_ in zip(gg, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4, rtol=1e-4)
+
+
 def test_ring_attention_window():
     mesh = _sp_mesh(4)
     k1, k2, k3 = jax.random.split(jax.random.PRNGKey(5), 3)
