@@ -75,6 +75,11 @@ class Attention(nn.Module):
     mesh: Optional[Any] = None
     sp_local: bool = False
     quant: str = ""  # "" | "int8": weight-streamed decode (orion_tpu/quant.py)
+    # set by the FULL-manual pipeline (parallel/pipeline_lm.py): the
+    # enclosing shard_map is manual over every axis, so Mosaic kernels are
+    # legal in the sp-local bodies; the partial-manual default pins them
+    # to the XLA forms
+    sp_local_kernels: bool = False
 
     def setup(self):
         cfg = self.cfg
@@ -184,19 +189,19 @@ class Attention(nn.Module):
             if self.sp_local and self.causal:
                 from orion_tpu.parallel.sequence import sp_linear_attention_local
 
-                # Inside the pipeline the XLA chunked form is STRUCTURAL,
-                # not a temporary fallback: the pipeline shard_map is
-                # partial-manual ({pp, sp} manual, dp/fsdp/tp left to GSPMD
-                # so batch/tensor sharding compose), and jax's
-                # tpu_custom_call lowering rejects Mosaic kernels in any
-                # partial-manual region ("cannot be automatically
-                # partitioned") — verified by topology-AOT compiles against
-                # v5e:2x4. Every FULLY-manual composition does carry the
-                # kernels: plain GSPMD meshes via parallel/kernel_shard.py
-                # and sp-without-pp via sequence.py/ring.py (axis_names
-                # defaulted = all axes manual) — SP_PALLAS_AOT.json.
+                # In the partial-manual pipeline the XLA chunked form is
+                # STRUCTURAL, not a fallback: jax rejects Mosaic kernels in
+                # any partial-manual region ("cannot be automatically
+                # partitioned"), and that pipeline leaves dp/fsdp/tp to
+                # GSPMD by design. The FULL-manual pipeline
+                # (pipeline_lm.py full_manual) sets sp_local_kernels and
+                # the requested backend goes through — every other
+                # fully-manual composition already carries kernels
+                # (kernel_shard.py; sequence.py/ring.py).
                 out = sp_linear_attention_local(
-                    qf, kf, v, backend="xla", chunk=cfg.chunk
+                    qf, kf, v,
+                    backend=cfg.backend if self.sp_local_kernels else "xla",
+                    chunk=cfg.chunk,
                 )
             elif sp:
                 from orion_tpu.parallel.sequence import sp_linear_attention
@@ -230,11 +235,28 @@ class Attention(nn.Module):
             # a window loses its locality)
             striped = cfg.ring_striped and window is None
             if self.sp_local and self.causal:
-                from orion_tpu.parallel.ring import ring_attention_local
-
-                out = ring_attention_local(
-                    q, k, v, causal=True, window=window, striped=striped
+                from orion_tpu.ops.dispatch import resolve
+                from orion_tpu.parallel.ring import (
+                    ring_attention_local,
+                    swa_halo_attention_local,
                 )
+
+                # sp_local_kernels (full-manual pipeline): kernel-backed
+                # forms — halo for swa; full-causal softmax gets flash
+                # blocks only when cfg.ring_striped is set (the contiguous
+                # ring body is XLA regardless of backend). Partial-manual
+                # pipelines always use the XLA bodies.
+                b = resolve(cfg.backend) if self.sp_local_kernels else "xla"
+                if window is not None and b.startswith("pallas"):
+                    out = swa_halo_attention_local(
+                        q, k, v, window=window,
+                        interpret=(b == "pallas_interpret"),
+                    )
+                else:
+                    out = ring_attention_local(
+                        q, k, v, causal=True, window=window,
+                        striped=striped, backend=b,
+                    )
             elif sp:
                 from orion_tpu.ops.dispatch import resolve
                 from orion_tpu.parallel.ring import (
@@ -413,12 +435,14 @@ class Block(nn.Module):
     sp_local: bool = False
     use_moe: bool = False
     quant: str = ""
+    sp_local_kernels: bool = False
 
     def setup(self):
         self.norm1 = _norm(self.cfg, "norm1")
         self.attn = Attention(
             self.cfg, self.layer_type, self.causal, self.mesh,
-            self.sp_local, quant=self.quant, name="attn"
+            self.sp_local, quant=self.quant,
+            sp_local_kernels=self.sp_local_kernels, name="attn"
         )
         self.norm2 = _norm(self.cfg, "norm2")
         if self.use_moe:

@@ -104,6 +104,7 @@ def pipeline_apply(
     extra_manual_axes: tuple = (),
     x_spec: Any = None,
     with_aux: bool = False,
+    full_manual: bool = False,
 ):
     """Apply L stacked layers to ``x`` [B, ...] as a pp-stage pipeline.
 
@@ -138,23 +139,51 @@ def pipeline_apply(
     mean of per-microbatch stats — exactly equal to non-pp at n_micro=1,
     statistically equivalent otherwise) and, when sp is manual, averaged
     over sp shards.
+
+    ``full_manual``: make EVERY mesh axis manual, which is what lets
+    Mosaic (Pallas) kernels lower inside the pipeline body — jax rejects
+    tpu_custom_call in partial-manual regions. The batch rides the
+    (dp, fsdp) axes explicitly (each device pipelines its local batch;
+    shard_map's transpose inserts the dp grad psums the auto path got
+    from GSPMD), so this mode requires tp == ep == 1: tensor/expert
+    sharding inside the body would need hand-written Megatron/MoE
+    collectives rather than data placement. The partial-manual default
+    remains the general composition.
     """
     pp = mesh.shape[axis]
     if pp == 1 and not extra_manual_axes:
         return _stage_apply(layer_fn, stacked_params, x, rng, with_aux)
     b = x.shape[0]
-    assert b % n_micro == 0, (b, n_micro)
+    n_batch_shards = 1
+    if full_manual:
+        assert mesh.shape.get("tp", 1) == 1 and mesh.shape.get("ep", 1) == 1, (
+            "full_manual pipeline requires tp == ep == 1 "
+            f"(got {dict(mesh.shape)}): tensor/expert sharding inside a "
+            "fully-manual body needs explicit collectives"
+        )
+        n_batch_shards = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        assert b % n_batch_shards == 0, (
+            f"full_manual pipeline: batch {b} must divide over the "
+            f"{n_batch_shards} dp*fsdp shards"
+        )
+    assert (b // n_batch_shards) % n_micro == 0, (
+        f"n_micro={n_micro} must divide the per-shard batch "
+        f"{b // n_batch_shards} (global {b} over {n_batch_shards} batch "
+        f"shards{' — full_manual shards the batch explicitly' if full_manual else ''})"
+    )
     leaves = jax.tree.leaves(stacked_params)
     n_layers = leaves[0].shape[0]
     assert n_layers % pp == 0, (n_layers, pp)
 
     def local(params_local, x_all):
         """shard_map body. params_local leaves: [L/pp, ...] (this stage's
-        layers). x_all: the FULL batch [B, ...] (replicated over pp) —
-        each stage computes every microbatch but only its own stage slice,
-        so the activation ring carries one microbatch-sized buffer."""
+        layers). x_all: the batch (replicated over pp; LOCAL over dp/fsdp
+        in full_manual mode) — each stage computes every microbatch but
+        only its own stage slice, so the activation ring carries one
+        microbatch-sized buffer."""
         i = lax.axis_index(axis)
-        micro = x_all.reshape(n_micro, b // n_micro, *x_all.shape[1:])
+        b_loc = x_all.shape[0]  # == b unless full_manual shards the batch
+        micro = x_all.reshape(n_micro, b_loc // n_micro, *x_all.shape[1:])
         # the scan carry is device-varying (each stage holds different
         # activations); mark the replicated initializers/input accordingly
         # so shard_map's varying-mesh-axes check can verify the body
@@ -167,10 +196,13 @@ def pipeline_apply(
         zeros = jnp.zeros_like(micro[0])
         out0 = jnp.zeros_like(micro)
         aux0 = jnp.zeros((), jnp.float32)
+        aux_axes = (axis,) + tuple(extra_manual_axes)
+        if full_manual:
+            aux_axes = aux_axes + ("dp", "fsdp")
         if hasattr(lax, "pcast"):
-            aux0 = lax.pcast(aux0, (axis,) + tuple(extra_manual_axes), to="varying")
+            aux0 = lax.pcast(aux0, aux_axes, to="varying")
         else:
-            aux0 = lax.pvary(aux0, (axis,) + tuple(extra_manual_axes))
+            aux0 = lax.pvary(aux0, aux_axes)
 
         def step(carry, s):
             buf, outs, aux_tot = carry
@@ -186,10 +218,14 @@ def pipeline_apply(
                 # the within-stage slot on top -> unique per layer×micro
                 m = jnp.clip(s - i, 0, n_micro - 1)
                 step_rng = jax.random.fold_in(jax.random.fold_in(rng, m), i)
-                # extra manual axes (sp): each shard draws only its local
-                # slice, so the key must differ per shard or masks repeat
-                # along the sharded dim with 1/|axis| the intended entropy
-                for ax in extra_manual_axes:
+                # manual sharded axes (sp always; dp/fsdp in full_manual):
+                # each shard draws only its local slice, so the key must
+                # differ per shard or masks repeat along the sharded dim
+                # with 1/|axis| the intended entropy
+                rng_axes = tuple(extra_manual_axes)
+                if full_manual:
+                    rng_axes = rng_axes + ("dp", "fsdp")
+                for ax in rng_axes:
                     step_rng = jax.random.fold_in(step_rng, lax.axis_index(ax))
             if with_aux:
                 h_out, aux_s = _stage_apply(
@@ -218,7 +254,7 @@ def pipeline_apply(
         # every stage ran the scan; only the last stage's banked outputs are
         # real — broadcast them back over pp so out_specs can be replicated
         outs = lax.psum(jnp.where(i == pp - 1, outs, jnp.zeros_like(outs)), axis)
-        out = outs.reshape(b, *x_all.shape[1:])
+        out = outs.reshape(b_loc, *x_all.shape[1:])
         if not with_aux:
             return out
         # stages hold disjoint layers: sum over pp; each layer sowed once
@@ -226,19 +262,35 @@ def pipeline_apply(
         aux = lax.psum(aux_tot, axis) / n_micro
         for ax in extra_manual_axes:
             aux = lax.pmean(aux, ax)
+        if full_manual:
+            # batch shards each averaged their own tokens; the P() out_spec
+            # promises a replicated (unvarying) scalar
+            for ax in ("dp", "fsdp"):
+                aux = lax.pmean(aux, ax)
         return out, aux
 
     pspec = jax.tree.map(lambda _: P(axis), stacked_params)
-    xs = P() if x_spec is None else x_spec
+    if x_spec is not None:
+        xs = x_spec
+    elif full_manual:
+        xs = P(("dp", "fsdp"))
+    else:
+        xs = P()
+    manual = (
+        frozenset(mesh.axis_names)
+        if full_manual
+        else frozenset({axis}) | frozenset(extra_manual_axes)
+    )
     fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(pspec, xs),
         out_specs=(xs, P()) if with_aux else xs,
-        # partial-manual: pp (and any extra axes the body's collectives
-        # need, e.g. sp) are manual; dp/fsdp/tp stay automatic so this
-        # composes with GSPMD batch/tensor sharding in the trainer
-        axis_names=frozenset({axis}) | frozenset(extra_manual_axes),
+        # partial-manual default: pp (and any extra axes the body's
+        # collectives need, e.g. sp) are manual; dp/fsdp/tp stay automatic
+        # so this composes with GSPMD batch/tensor sharding in the trainer.
+        # full_manual: every axis manual (docstring) — the Mosaic-legal form.
+        axis_names=manual,
         # vma stays tracked: the transpose of the pp-replicated x input is a
         # psum over pp, whose type rule *requires* tracked vma — so unlike
         # sequence.py this shard_map cannot run check_vma=False, and the

@@ -112,6 +112,7 @@ def pp_lm_logits(
     axis: str = "pp",
     dropout_rng: Any = None,
     return_aux: bool = False,
+    full_manual: Any = None,
 ):
     """tokens [B, T] -> logits [B, T, V], blocks executed as a pp pipeline.
 
@@ -121,6 +122,13 @@ def pp_lm_logits(
     masks — see pipeline_apply). ``return_aux`` returns (logits, aux) where
     aux is the microbatch-averaged sum of the blocks' sown "losses"
     collection (MoE load-balance/z losses, models/moe.py).
+
+    ``full_manual`` (None = auto): run the pipeline shard_map manual over
+    EVERY mesh axis — the Mosaic-legal form (pipeline_apply docstring), so
+    a ``backend="pallas"`` model keeps its kernels inside the pipeline
+    body instead of falling back to the XLA attention forms. Auto turns it
+    on exactly when it is both needed and possible: a real-Mosaic backend
+    on a tp == ep == 1 mesh.
     """
     cfg = model.cfg
     assert model.mesh is None or model.mesh is mesh, (
@@ -142,10 +150,25 @@ def pp_lm_logits(
         assert tokens.shape[-1] % mesh.shape["sp"] == 0, (
             tokens.shape, dict(mesh.shape)
         )
+    if full_manual is None:
+        from orion_tpu.ops.dispatch import resolve
+
+        # auto only when it costs nothing: a real-Mosaic backend and no
+        # axis whose sharding the manual body would have to re-implement.
+        # fsdp > 1 is deliberately EXCLUDED from auto — full_manual enters
+        # stage params via P('pp'), gathering the full stage up front
+        # instead of GSPMD's layer-at-a-time gather, so it trades ZeRO
+        # memory for kernels; opt in explicitly if that trade is wanted.
+        full_manual = (
+            resolve(cfg.backend) == "pallas"
+            and mesh.shape.get("tp", 1) == 1
+            and mesh.shape.get("ep", 1) == 1
+            and mesh.shape.get("fsdp", 1) == 1
+        )
     blocks = [
         Block(
             cfg, cfg.resolved_layer_types[j], True, None, sp_on,
-            use_moe=cfg.moe_at(j),
+            use_moe=cfg.moe_at(j), sp_local_kernels=bool(full_manual),
         )
         for j in range(g)
     ]
@@ -191,6 +214,10 @@ def pp_lm_logits(
 
     from jax.sharding import PartitionSpec as P
 
+    if full_manual:
+        x_spec = P(("dp", "fsdp"), "sp" if sp_on else None, None)
+    else:
+        x_spec = P(None, "sp", None) if sp_on else None
     out = pipeline_apply(
         stacked, x, layer_fn, mesh, n_micro=n_micro, axis=axis,
         rng=dropout_rng,
@@ -198,8 +225,9 @@ def pp_lm_logits(
         # regions don't lower); blocks then run the sp-local attention
         # bodies on sp-local token shards
         extra_manual_axes=("sp",) if sp_on else (),
-        x_spec=P(None, "sp", None) if sp_on else None,
+        x_spec=x_spec,
         with_aux=return_aux,
+        full_manual=full_manual,
     )
     x, aux = out if return_aux else (out, None)
     logits = model.apply(params, x, method=lambda m, h: m._head(h))
@@ -215,6 +243,7 @@ def pp_lm_loss(
     n_micro: int,
     axis: str = "pp",
     dropout_rng: Any = None,
+    full_manual: Any = None,
 ) -> Array:
     """batch [B, T+1] -> mean next-token cross entropy under the pipeline
     (+ microbatch-averaged MoE aux losses for MoE models)."""
@@ -224,7 +253,7 @@ def pp_lm_loss(
     moe = model.cfg.n_experts > 0
     out = pp_lm_logits(
         model, params, x, mesh, n_micro=n_micro, axis=axis,
-        dropout_rng=dropout_rng, return_aux=moe,
+        dropout_rng=dropout_rng, return_aux=moe, full_manual=full_manual,
     )
     logits, aux = out if moe else (out, None)
     loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()

@@ -65,6 +65,11 @@ class TrainConfig:
     # pipeline parallelism (mesh.pp > 1): number of GPipe microbatches;
     # 0 = auto (4*pp capped at batch_size). Bubble = (pp-1)/(n_micro+pp-1).
     pp_microbatches: int = 0
+    # None = auto (parallel/pipeline_lm.py: real-Mosaic backend on a
+    # tp==ep==1, fsdp==1 mesh); True forces the fully-manual pipeline
+    # (Mosaic kernels inside pp, batch explicit on dp/fsdp — with fsdp>1
+    # this trades ZeRO memory for kernels); False forces partial-manual
+    pp_full_manual: Optional[bool] = None
     # bookkeeping
     seed: int = 0
     log_every: int = 10
@@ -315,6 +320,7 @@ class Trainer:
                     self.model, params, b, self.mesh,
                     n_micro=self.pp_n_micro,
                     dropout_rng=r if use_dropout else None,
+                    full_manual=cfg.pp_full_manual,
                 )
             return lm_loss(self.model, params, b, r if use_dropout else None)
 
