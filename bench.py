@@ -37,6 +37,52 @@ def _enable_compile_cache():
     enable_compile_cache(os.path.join(os.path.dirname(__file__), ".jax_cache"))
 
 
+def _probe_backend(timeout_s: int = 600) -> None:
+    """Touch the device once IN A SUBPROCESS with a hard-kill bound. The
+    axon relay can wedge server-side (observed: a killed client left every
+    later backend init hanging >4h, blocked in a C call that ignores both
+    SIGALRM and SIGTERM — an in-process watchdog cannot fire), so the
+    probe must be a child the parent can SIGKILL. Fail fast with a
+    diagnostic instead of hanging the driver's bench step forever."""
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "jnp.zeros((8, 8)).block_until_ready()\n"
+        "print(jax.devices())\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        proc.kill()
+        try:
+            # bounded: a D-state child ignores even SIGKILL, and an
+            # unbounded wait() here would hang the parent — the exact
+            # outcome this probe exists to prevent
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        tail = (e.stderr or b"")
+        if isinstance(tail, bytes):
+            tail = tail.decode(errors="replace")
+        raise TimeoutError(
+            f"TPU backend init did not complete in {timeout_s}s — relay "
+            "wedged? (see BASELINE.md topology-AOT section for the "
+            "hardware-free validation story) "
+            + ("child stderr tail: " + tail.strip()[-300:] if tail else "")
+        )
+    if proc.returncode != 0:
+        raise TimeoutError(
+            f"TPU backend probe failed rc={proc.returncode}: "
+            + err.strip()[-300:]
+        )
+    print(f"backend ok: {out.strip()[-120:]}", file=sys.stderr)
+
+
 def _build(batch_size: int, seq_len: int, config: str = "lm_1b3"):
     import jax.numpy as jnp
 
@@ -283,6 +329,11 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     _enable_compile_cache()
+    try:
+        _probe_backend()
+    except TimeoutError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
 
     if args.decode_matrix:
         mat = decode_matrix()
