@@ -244,6 +244,26 @@ class Trainer:
             # the pipeline sees one accumulation micro-batch at a time, so
             # GPipe microbatches must divide cfg.micro_batch, not batch_size
             base = cfg.micro_batch
+            # a full_manual pipeline shards the batch over dp·fsdp
+            # EXPLICITLY, so n_micro must divide the PER-SHARD batch —
+            # mirror pipeline_lm.py's auto rule (True, or None + a
+            # real-Mosaic backend on a tp==ep==1, fsdp==1 mesh) so the
+            # auto heuristic never picks a divisor the pipeline rejects
+            from orion_tpu.ops.dispatch import resolve as _resolve
+
+            fm = cfg.pp_full_manual
+            if fm is None:
+                fm = (
+                    _resolve(cfg.model.backend) == "pallas"
+                    and self.mesh.shape.get("tp", 1) == 1
+                    and self.mesh.shape.get("ep", 1) == 1
+                    and self.mesh.shape.get("fsdp", 1) == 1
+                )
+            if fm:
+                base = base // (
+                    self.mesh.shape.get("dp", 1)
+                    * self.mesh.shape.get("fsdp", 1)
+                )
             if cfg.pp_microbatches:
                 self.pp_n_micro = cfg.pp_microbatches
             else:  # auto: largest divisor of base not exceeding 4*pp
@@ -253,7 +273,7 @@ class Trainer:
                 )
             assert base % self.pp_n_micro == 0, (
                 f"pp_microbatches={self.pp_n_micro} must divide the "
-                f"per-accumulation batch {base}"
+                f"{'per-shard ' if fm else ''}per-accumulation batch {base}"
             )
         self.tx = make_optimizer(cfg, include_clip=False)
         self.sched = make_schedule(cfg)
