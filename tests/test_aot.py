@@ -134,11 +134,14 @@ def test_topology_aot_pallas_under_pp_sp():
     region, compiled by the real TPU compiler."""
     mc = MeshConfig(dp=2, pp=2, sp=2)
     mesh = _topo_mesh_or_skip(mc)
+    # all three sp-local kernel forms inside the pipeline: fused-parts
+    # linear, halo swa, and the striped ring's flash blocks (softmax +
+    # ring_striped); pattern period 4 over 8 layers -> 2 pp stage groups
     model = ModelConfig(
-        name="ppsp_pallas", vocab_size=512, d_model=256, n_layers=4,
-        n_heads=4, layer_types=("linear", "swa") * 2, window=256,
-        max_seq_len=1024, dtype="bfloat16", backend="pallas", remat=True,
-        sequence_parallel=True,
+        name="ppsp_pallas", vocab_size=512, d_model=256, n_layers=8,
+        n_heads=4, layer_types=("linear", "swa", "softmax", "linear") * 2,
+        window=256, max_seq_len=1024, dtype="bfloat16", backend="pallas",
+        remat=True, sequence_parallel=True, ring_striped=True,
     )
     cfg = TrainConfig(
         model=model, batch_size=8, seq_len=1024, mesh=mc, pp_microbatches=2,

@@ -154,6 +154,11 @@ def test_pp_full_manual_parity(sp_on):
         name="pp_fm", vocab_size=64, d_model=32, n_layers=4, n_heads=2,
         max_seq_len=32, dtype="float32", backend="xla",
         sequence_parallel=sp_on,
+        # sp variant also runs the striped ring's XLA body for the softmax
+        # layers inside the pipeline (the kernel content of the same
+        # region is compiled by the topology-AOT pp×sp test)
+        layer_types=("linear", "softmax") * 2 if sp_on else None,
+        ring_striped=sp_on,
     )
     model = TransformerLM(cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
