@@ -48,6 +48,13 @@ class ModelConfig:
     chunk: Optional[int] = None  # linear-attn chunk size (None = tuned default)
     remat: bool = False  # per-block activation checkpointing
     remat_policy: str = "full"  # "full" | "dots" (save matmul outputs)
+    # leave the last remat_skip blocks UN-rematted (identical math, they
+    # keep their activations instead of recomputing the forward in the
+    # backward pass). Each skipped flagship block trades ~1.6GB of saved
+    # activations for ~22ms of recompute (BASELINE.md train-step profile);
+    # the fused-CE loss (ops/fused_ce.py) frees enough temp HBM to pay for
+    # several. Ignored when remat=False.
+    remat_skip: int = 0
     # sequence/context parallelism: when True and the model is built with a
     # mesh whose sp axis > 1, causal attention runs sharded over tokens —
     # linear layers via the kv-state exclusive prefix (parallel/sequence.py),
@@ -124,6 +131,10 @@ LM_1B3 = ModelConfig(
     max_seq_len=2048,
     dtype="bfloat16",
     remat=True,
+    # 4 un-rematted blocks fit the 16GB v5e at batch 16 x T 2048 once the
+    # fused-CE loss stops materializing fp32 logits; 6 no longer compile
+    # there. Worth +2.7% step time on-chip (BASELINE.md round-3 rows).
+    remat_skip=4,
 )
 
 HYBRID_7B = ModelConfig(

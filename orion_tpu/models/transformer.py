@@ -495,8 +495,10 @@ class TransformerLM(nn.Module):
             block_cls = nn.remat(
                 Block, static_argnums=(3,), policy=REMAT_POLICIES[cfg.remat_policy]
             )
+        # remat_skip: the last K blocks keep their activations (configs.py)
+        first_remat = cfg.n_layers - max(0, cfg.remat_skip)
         self.blocks = [
-            block_cls(
+            (block_cls if i < first_remat else Block)(
                 cfg, lt, True, self.mesh,
                 use_moe=cfg.moe_at(i), quant=self.quant, name=f"block_{i}",
             )
@@ -566,10 +568,14 @@ class TransformerLM(nn.Module):
         return x
 
     def _head(self, x: Array) -> Array:
+        """final_norm + head matmul (prefill/decode call this on raw block
+        output)."""
+        return self._head_matmul(self.final_norm(x))
+
+    def _head_matmul(self, x: Array) -> Array:
         """Logits in fp32, but the matmul itself runs in the compute dtype
         with fp32 MXU accumulation — a pure-fp32 [.., D]x[D, V] head matmul
         is ~4x slower on TPU for no useful precision gain."""
-        x = self.final_norm(x)
         cdt = _dtype(self.cfg.dtype)
         if self.quant == "int8":
             if self.cfg.tie_embeddings:
@@ -595,11 +601,28 @@ class TransformerLM(nn.Module):
 
     def __call__(self, tokens: Array, deterministic: bool = True) -> Array:
         """tokens [B, T] -> logits [B, T, V] (fp32)."""
+        return self._head_matmul(self.features(tokens, deterministic))
+
+    def features(self, tokens: Array, deterministic: bool = True) -> Array:
+        """tokens [B, T] -> final-normed hidden states [B, T, D], i.e. the
+        head matmul's input. The fused-CE training path (ops/fused_ce.py)
+        consumes this and applies the head inside its chunked scan, so the
+        full [B, T, V] fp32 logits never materialize; __call__ is exactly
+        ``_head_matmul(features(tokens))``."""
         t = tokens.shape[-1]
         x = self._embed(tokens, jnp.arange(t))
         for blk in self.blocks:
             x = blk(x, None, deterministic)
-        return self._head(x)
+        return self.final_norm(x)
+
+    def head_weight(self, params) -> Tuple[Array, bool]:
+        """(head weight array, w_is_vd) for ops/fused_ce.py — the tied
+        embedding [V, D] or the untied lm_head_kernel [D, V]. Static method
+        in spirit: reads the param pytree, no module state."""
+        p = params["params"]
+        if self.cfg.tie_embeddings:
+            return p["embed"]["embedding"], True
+        return p["lm_head_kernel"], False
 
     def prefill(self, tokens: Array) -> Tuple[Array, List[State]]:
         """tokens [B, T] -> (logits [B, T, V], per-layer decode states)."""

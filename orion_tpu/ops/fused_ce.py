@@ -1,0 +1,150 @@
+"""Chunked fused linear-cross-entropy: the LM head matmul and the softmax
+cross entropy computed together, one sequence chunk at a time, so the full
+``[B, T, V]`` fp32 logits tensor never exists in HBM.
+
+Why (measured in this repo — BASELINE.md "Train-step profile"): at the
+flagship shapes (batch 16 x T 2048 x V 32k) the unfused head materializes
+4.3GB of fp32 logits, reads them back for the log-sum-exp, materializes
+their 4.3GB cotangent ``softmax - onehot``, and feeds THAT back through the
+head matmul's backward — ~100ms/step of pure HBM traffic on reduce+fusion
+passes, plus 4-8GB of peak temp memory that caps the batch size. The fused
+form recomputes each logits chunk in the backward (one extra ``x @ W`` pass,
+~22ms of MXU time at these shapes) and keeps every [chunk, V] block local:
+net faster, and the freed HBM buys no-remat blocks (ModelConfig.remat_skip)
+worth far more than the recompute costs.
+
+The reference's training path computes the same loss unfused (reference:
+BASELINE.json north_star / configs #3 — its CUDA framework materializes
+logits; the checkout was never mounted, SURVEY.md §0). This is the
+TPU-native replacement, not a translation: chunking rides ``lax.scan`` with
+static shapes so XLA pipelines the chunk matmuls back-to-back on the MXU.
+
+Semantics: ``fused_linear_cross_entropy(x, w, labels)`` equals
+``optax.softmax_cross_entropy_with_integer_labels(head(x), labels)``
+token-for-token (parity: tests/test_fused_ce.py), where ``head`` is the
+bf16-matmul / fp32-accumulation head (models/transformer.py::_head).
+Gradients flow to ``x`` and ``w``; ``labels`` (integer) get a float0
+cotangent.
+
+Sharding: chunks are cut along T with batch leading, so dp/fsdp batch
+sharding passes straight through the scan; tp partitions each chunk matmul
+exactly like the unfused head. Sequence-parallel (sp>1) meshes keep the
+unfused path — a T-chunked scan would slice across the token sharding
+(training/trainer.py gates this).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+__all__ = ["fused_linear_cross_entropy", "pick_n_chunks"]
+
+# ~rows of each chunk matmul: big enough to fill the MXU (>=8 sublane tiles
+# of 8x128 per 128-row pass), small enough that the [rows, V] fp32 logits
+# block stays ~256MB at V=32k
+_TARGET_ROWS = 2048
+
+
+def pick_n_chunks(batch: int, seq: int) -> int:
+    """Largest divisor of ``seq`` keeping ~_TARGET_ROWS tokens per chunk."""
+    cap = max(1, (batch * seq) // _TARGET_ROWS)
+    best = 1
+    for d in range(1, seq + 1):
+        if d > cap:
+            break
+        if seq % d == 0:
+            best = d
+    return best
+
+
+def _logits_chunk(xc: Array, w: Array, w_is_vd: bool) -> Array:
+    """[B, C, D] x head weight -> [B, C, V] fp32 (bf16 MXU, fp32 accum —
+    same contraction the unfused head runs, transformer.py::_head)."""
+    spec = "bcd,vd->bcv" if w_is_vd else "bcd,dv->bcv"
+    return jnp.einsum(spec, xc, w, preferred_element_type=jnp.float32)
+
+
+def _split(a: Array, n_chunks: int) -> Array:
+    """[B, T, ...] -> [n_chunks, B, C, ...] (batch stays a leading dim of
+    every scan step, preserving dp/fsdp sharding)."""
+    b, t = a.shape[0], a.shape[1]
+    return a.reshape((b, n_chunks, t // n_chunks) + a.shape[2:]).swapaxes(0, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(
+    x: Array, w: Array, labels: Array, n_chunks: int = 1, w_is_vd: bool = True
+) -> Array:
+    """Per-token cross entropy [B, T] of the fused head(x) vs labels.
+
+    x: [B, T, D] activations in the compute dtype (the head casts w to
+       x.dtype for the matmul, like transformer.py::_head)
+    w: [V, D] (w_is_vd=True, tied embedding) or [D, V] (lm_head_kernel)
+    labels: [B, T] int32; n_chunks must divide T (pick_n_chunks)
+    """
+    out, _ = _fwd(x, w, labels, n_chunks, w_is_vd)
+    return out
+
+
+def _fwd(x, w, labels, n_chunks, w_is_vd):
+    wc = w.astype(x.dtype)
+    xs, ys = _split(x, n_chunks), _split(labels, n_chunks)
+
+    def body(_, xy):
+        xc, yc = xy
+        logits = _logits_chunk(xc, wc, w_is_vd)
+        m = logits.max(-1)
+        lse = m + jnp.log(jnp.exp(logits - m[..., None]).sum(-1))
+        picked = jnp.take_along_axis(logits, yc[..., None], axis=-1)[..., 0]
+        return None, (lse - picked, lse)
+
+    _, (loss, lse) = jax.lax.scan(body, None, (xs, ys))
+    b, t = labels.shape
+    # residuals: inputs (already live) + the [B, T] fp32 lse — never logits
+    return loss.swapaxes(0, 1).reshape(b, t), (x, w, labels, lse)
+
+
+def _bwd(n_chunks, w_is_vd, res, g) -> Tuple[Array, Array, np.ndarray]:
+    x, w, labels, lse = res  # lse [n_chunks, B, C]
+    v = w.shape[0] if w_is_vd else w.shape[1]
+    cdt = x.dtype
+    wc = w.astype(cdt)
+    xs, ys, gs = _split(x, n_chunks), _split(labels, n_chunks), _split(g, n_chunks)
+
+    def body(dw, inp):
+        xc, yc, lsec, gc = inp
+        logits = _logits_chunk(xc, wc, w_is_vd)  # recomputed, fp32
+        p = jnp.exp(logits - lsec[..., None])
+        dlog = (p - jax.nn.one_hot(yc, v, dtype=p.dtype)) * gc[..., None]
+        dl = dlog.astype(cdt)  # bf16 into the MXU, fp32 accumulation out
+        dxc = jnp.einsum(
+            "bcv,vd->bcd" if w_is_vd else "bcv,dv->bcd", dl, wc,
+            preferred_element_type=jnp.float32,
+        )
+        dwc = (
+            jnp.einsum("bcv,bcd->vd", dl, xc,
+                       preferred_element_type=jnp.float32)
+            if w_is_vd else
+            jnp.einsum("bcd,bcv->dv", xc, dl,
+                       preferred_element_type=jnp.float32)
+        )
+        return dw + dwc, dxc.astype(cdt)
+
+    dw, dxs = jax.lax.scan(
+        body, jnp.zeros(w.shape, jnp.float32), (xs, ys, lse, gs)
+    )
+    b, t = labels.shape
+    dx = dxs.swapaxes(0, 1).reshape(x.shape)
+    # integer labels: float0 cotangent (the JAX convention for int primals)
+    dy = np.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return dx, dw.astype(w.dtype), dy
+
+
+fused_linear_cross_entropy.defvjp(_fwd, _bwd)

@@ -32,7 +32,7 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from orion_tpu.models.configs import ModelConfig
-from orion_tpu.models.transformer import TransformerLM
+from orion_tpu.models.transformer import TransformerLM, _dtype
 from orion_tpu.parallel.mesh import MeshConfig, make_mesh
 from orion_tpu.parallel.sharding import batch_sharding, param_shardings
 from orion_tpu.utils import rng as rngs
@@ -179,16 +179,54 @@ def make_optimizer(
     return optax.chain(*chain)
 
 
-def lm_loss(model: TransformerLM, params, batch: Array, dropout_rng=None):
+def _fused_ce_ok(model: TransformerLM) -> bool:
+    """The fused head+CE path (ops/fused_ce.py) applies everywhere except:
+    sp meshes (its T-chunked scan would slice across the token sharding —
+    the unfused head lowers cleanly there) and quantized models (decode-only
+    path, never trained)."""
+    if getattr(model, "quant", ""):
+        return False
+    if (
+        model.cfg.sequence_parallel
+        and model.mesh is not None
+        and model.mesh.shape.get("sp", 1) > 1
+    ):
+        return False
+    return True
+
+
+def lm_loss(
+    model: TransformerLM, params, batch: Array, dropout_rng=None,
+    fused_ce: Optional[bool] = None,
+):
     """batch [B, T+1] -> mean next-token cross entropy (fp32), plus any
     auxiliary losses modules sowed into the "losses" collection (MoE
-    load-balance + z-loss, models/moe.py — already weighted there)."""
+    load-balance + z-loss, models/moe.py — already weighted there).
+
+    ``fused_ce``: None = auto (_fused_ce_ok); the fused path computes the
+    identical loss without materializing [B, T, V] fp32 logits."""
     x, y = batch[:, :-1], batch[:, 1:]
     kwargs = {}
     if dropout_rng is not None:
         kwargs = {"rngs": {"dropout": dropout_rng}, "deterministic": False}
-    logits, variables = model.apply(params, x, mutable="losses", **kwargs)
-    losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+    if fused_ce is None:
+        fused_ce = _fused_ce_ok(model)
+    if fused_ce:
+        from orion_tpu.ops.fused_ce import (
+            fused_linear_cross_entropy, pick_n_chunks,
+        )
+
+        feats, variables = model.apply(
+            params, x, mutable="losses", method="features", **kwargs
+        )
+        w, w_is_vd = model.head_weight(params)
+        feats = feats.astype(_dtype(model.cfg.dtype))
+        losses = fused_linear_cross_entropy(
+            feats, w, y, pick_n_chunks(*y.shape), w_is_vd
+        )
+    else:
+        logits, variables = model.apply(params, x, mutable="losses", **kwargs)
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
     loss = losses.mean()
     for leaf in jax.tree.leaves(variables.get("losses", {})):
         loss = loss + leaf
@@ -220,6 +258,17 @@ class Trainer:
         # constraints; the sp attention path additionally gates on
         # cfg.sequence_parallel and mesh sp-axis size > 1
         self.model = TransformerLM(cfg.model, mesh=self.mesh)
+        # remat_skip's memory budget assumes the fused-CE loss freed the
+        # fp32-logits temp (configs.py LM_1B3). Paths that keep the unfused
+        # head — pp (pp_lm_loss builds its own stacked pipeline; remat_skip
+        # is meaningless there anyway) and sp (_fused_ce_ok) — get the skip
+        # zeroed so they never pay un-rematted activations AND full logits.
+        if cfg.model.remat_skip and (
+            self.mesh.shape.get("pp", 1) > 1 or not _fused_ce_ok(self.model)
+        ):
+            self.model = TransformerLM(
+                dataclasses.replace(cfg.model, remat_skip=0), mesh=self.mesh
+            )
         # pipeline parallelism: blocks run as a GPipe pipeline over the pp
         # axis and the state stores block params STACKED on a leading layer
         # axis sharded over pp (parallel/pipeline_lm.py)

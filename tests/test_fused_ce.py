@@ -1,0 +1,133 @@
+"""Fused linear-cross-entropy (ops/fused_ce.py) parity vs the unfused
+head + optax loss it replaces (reference loss: BASELINE.json north_star
+training path; checkout never mounted — SURVEY.md §0)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from orion_tpu.ops.fused_ce import fused_linear_cross_entropy, pick_n_chunks
+
+
+def _ref_loss(x, w, labels, w_is_vd):
+    spec = "btd,vd->btv" if w_is_vd else "btd,dv->btv"
+    logits = jnp.einsum(
+        spec, x, w.astype(x.dtype), preferred_element_type=jnp.float32
+    )
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def _rand(b, t, d, v, dtype, seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    x = jax.random.normal(k1, (b, t, d), dtype)
+    w = jax.random.normal(k2, (v, d), jnp.float32) * 0.05
+    y = jax.random.randint(k3, (b, t), 0, v)
+    return x, w, y
+
+
+@pytest.mark.parametrize("n_chunks", [1, 2, 8])
+@pytest.mark.parametrize("w_is_vd", [True, False])
+def test_forward_parity(n_chunks, w_is_vd):
+    x, w, y = _rand(2, 16, 32, 64, jnp.float32)
+    if not w_is_vd:
+        w = w.T
+    got = fused_linear_cross_entropy(x, w, y, n_chunks, w_is_vd)
+    want = _ref_loss(x, w, y, w_is_vd)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("w_is_vd", [True, False])
+def test_grad_parity(w_is_vd):
+    x, w, y = _rand(2, 16, 32, 64, jnp.float32)
+    if not w_is_vd:
+        w = w.T
+
+    def fused(x, w):
+        return fused_linear_cross_entropy(x, w, y, 4, w_is_vd).mean()
+
+    def ref(x, w):
+        return _ref_loss(x, w, y, w_is_vd).mean()
+
+    (lf, (dxf, dwf)) = jax.value_and_grad(fused, argnums=(0, 1))(x, w)
+    (lr, (dxr, dwr)) = jax.value_and_grad(ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(lf, lr, rtol=1e-6)
+    np.testing.assert_allclose(dxf, dxr, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(dwf, dwr, rtol=1e-4, atol=1e-6)
+
+
+def test_bf16_matches_unfused_bf16_head():
+    # bf16 activations, fp32 weights: both paths cast w to bf16 for the
+    # matmul and accumulate fp32 — identical numerics, not just close
+    x, w, y = _rand(2, 32, 64, 128, jnp.bfloat16, seed=1)
+    got = fused_linear_cross_entropy(x, w, y, 4, True)
+    want = _ref_loss(x, w, y, True)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_nonuniform_cotangent():
+    # per-token cotangents (e.g. masked/weighted losses) flow correctly
+    x, w, y = _rand(1, 8, 16, 32, jnp.float32, seed=2)
+    g = jnp.linspace(0.0, 1.0, 8).reshape(1, 8)
+
+    def fused(x):
+        return (fused_linear_cross_entropy(x, w, y, 2, True) * g).sum()
+
+    def ref(x):
+        return (_ref_loss(x, w, y, True) * g).sum()
+
+    np.testing.assert_allclose(
+        jax.grad(fused)(x), jax.grad(ref)(x), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_pick_n_chunks():
+    assert pick_n_chunks(16, 2048) == 16  # 16*128 = 2048 rows/chunk
+    assert pick_n_chunks(1, 64) == 1
+    # always divides T, even awkward T
+    for b, t in [(3, 96), (16, 2048), (2, 6), (1, 1)]:
+        n = pick_n_chunks(b, t)
+        assert t % n == 0
+
+
+def test_lm_loss_fused_matches_unfused():
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.training.trainer import lm_loss
+
+    cfg = get_config("tiny")
+    model = TransformerLM(cfg)
+    batch = jax.random.randint(
+        jax.random.PRNGKey(0), (2, 33), 0, cfg.vocab_size
+    )
+    params = model.init(jax.random.PRNGKey(1), batch[:, :-1])
+    lf, gf = jax.value_and_grad(
+        lambda p: lm_loss(model, p, batch, fused_ce=True)
+    )(params)
+    lu, gu = jax.value_and_grad(
+        lambda p: lm_loss(model, p, batch, fused_ce=False)
+    )(params)
+    np.testing.assert_allclose(lf, lu, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gu)):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+
+def test_lm_loss_fused_moe_aux_preserved():
+    # MoE models sow aux losses in the "losses" collection; the fused path
+    # must collect them exactly like the unfused one
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.training.trainer import lm_loss
+
+    cfg = get_config(
+        "tiny", n_experts=2, moe_period=2, moe_aux_weight=0.1
+    )
+    model = TransformerLM(cfg)
+    batch = jax.random.randint(
+        jax.random.PRNGKey(0), (2, 17), 0, cfg.vocab_size
+    )
+    params = model.init(jax.random.PRNGKey(1), batch[:, :-1])
+    lf = lm_loss(model, params, batch, fused_ce=True)
+    lu = lm_loss(model, params, batch, fused_ce=False)
+    np.testing.assert_allclose(lf, lu, rtol=1e-5)

@@ -139,6 +139,30 @@ def test_remat_matches_no_remat():
     )
 
 
+def test_remat_skip_matches():
+    # remat_skip leaves the last K blocks un-rematted: identical math,
+    # identical param tree (same block names/shapes), loss AND grads equal
+    cfg = dataclasses.replace(MIXED, remat=True, remat_skip=2)
+    toks = jax.random.randint(jax.random.PRNGKey(20), (1, 10), 0, cfg.vocab_size)
+    m = TransformerLM(dataclasses.replace(MIXED, remat=True))
+    ms = TransformerLM(cfg)
+    params = m.init(jax.random.PRNGKey(21), toks)
+    assert jax.tree.structure(params) == jax.tree.structure(
+        ms.init(jax.random.PRNGKey(21), toks)
+    )
+    np.testing.assert_allclose(
+        m.apply(params, toks), ms.apply(params, toks), atol=1e-6, rtol=1e-6
+    )
+
+    def loss(mod):
+        return lambda p: jnp.sum(mod.apply(p, toks) ** 2)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5),
+        jax.grad(loss(m))(params), jax.grad(loss(ms))(params),
+    )
+
+
 def test_remat_policy_dots_matches():
     cfg = dataclasses.replace(MIXED, remat=True, remat_policy="dots")
     toks = jax.random.randint(jax.random.PRNGKey(12), (1, 10), 0, cfg.vocab_size)
