@@ -83,7 +83,8 @@ def _probe_backend(timeout_s: int = 600) -> None:
     print(f"backend ok: {out.strip()[-120:]}", file=sys.stderr)
 
 
-def _build(batch_size: int, seq_len: int, config: str = "lm_1b3"):
+def _build(batch_size: int, seq_len: int, config: str = "lm_1b3",
+           remat_skip: Optional[int] = None):
     import jax.numpy as jnp
 
     from orion_tpu.models.configs import get_config
@@ -94,6 +95,8 @@ def _build(batch_size: int, seq_len: int, config: str = "lm_1b3"):
     model = dataclasses.replace(
         get_config(config), max_seq_len=seq_len, remat=True
     )
+    if remat_skip is not None:
+        model = dataclasses.replace(model, remat_skip=remat_skip)
     cfg = TrainConfig(
         model=model,
         steps=10**9,
@@ -139,13 +142,33 @@ def _n_active_params(trainer) -> float:
     return float(total)
 
 
+def _operating_points(config: str, seq_len: int):
+    """(batch_size, remat_skip) ladder, best-first, falling back on OOM.
+
+    The r3 on-chip sweep (BASELINE.md "batch x remat_skip") found the
+    throughput optimum is NOT the largest batch: un-rematted blocks scale
+    inversely with the token count, and at b12 x skip6 the saved recompute
+    beats b16 x skip4's amortization (14,007 vs 13,442 tok/s). remat_skip
+    None = the config's own default; ladder entries only override where the
+    sweep measured a win. Long-T rows keep the same token budget (32k) so
+    the same skips fit."""
+    if config == "lm_1b3":
+        if seq_len > 2048:  # fixed ~32k-token budget rows (BASELINE.md)
+            b0 = max(1, 32768 // seq_len)
+            return [(b0, 4), (max(1, b0 // 2), 6), (1, 8)]
+        return [(12, 6), (16, 4), (8, 8), (4, 8), (2, 8), (1, 8)]
+    if config == "hybrid_1b3":
+        return [(16, 4), (8, 6), (4, 6), (2, 6), (1, 6)]
+    return [(16, None), (8, None), (4, None), (2, None), (1, None)]
+
+
 def bench_train(
     seq_len: int = 2048, iters: int = 10, config: str = "lm_1b3"
 ) -> dict:
     last_err = None
-    for batch_size in (16, 8, 4, 2, 1):
+    for batch_size, remat_skip in _operating_points(config, seq_len):
         try:
-            trainer, batch = _build(batch_size, seq_len, config)
+            trainer, batch = _build(batch_size, seq_len, config, remat_skip)
             m = trainer.step(batch)  # compile + 1 step
             m = trainer.step(batch)  # warm
             float(m["loss"])  # readback barrier
@@ -160,6 +183,7 @@ def bench_train(
             return {
                 "tokens_per_sec": toks,
                 "batch_size": batch_size,
+                "remat_skip": remat_skip,
                 "seq_len": seq_len,
                 "step_ms": 1000 * dt / iters,
                 # 6·N_active FLOPs/token: for MoE only the routed share of

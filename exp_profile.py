@@ -72,7 +72,7 @@ def parse_trace(logdir: str, n_steps: int):
     for e in events:
         if e.get("ph") == "M" and e.get("name") == "process_name":
             pname = e.get("args", {}).get("name", "")
-            if "TPU" in pname and "Host" not in pname.lower():
+            if "TPU" in pname and "host" not in pname.lower():
                 dev_pids.add(e.get("pid"))
     by_group = {}
     by_name = {}

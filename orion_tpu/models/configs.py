@@ -165,6 +165,9 @@ HYBRID_1B3 = ModelConfig(
     max_seq_len=2048,
     dtype="bfloat16",
     remat=True,
+    # fits b16 x T2048 on the 16GB chip with fused CE; 6 fails to compile
+    # there (same sweep as LM_1B3's — BASELINE.md "batch x remat_skip")
+    remat_skip=4,
 )
 
 MOE_1B3_8E = ModelConfig(

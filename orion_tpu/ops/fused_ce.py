@@ -53,7 +53,12 @@ _TARGET_ROWS = 2048
 
 
 def pick_n_chunks(batch: int, seq: int) -> int:
-    """Largest divisor of ``seq`` keeping ~_TARGET_ROWS tokens per chunk."""
+    """Largest divisor of ``seq`` keeping ~_TARGET_ROWS tokens per chunk.
+
+    Warns when ``seq`` has no usable divisor (prime/odd T at large B): the
+    scan then runs as ONE chunk and materializes the full [B, T, V] logits
+    block — correct, but the memory the fused path exists to save (and the
+    headroom remat_skip budgets for) is not saved."""
     cap = max(1, (batch * seq) // _TARGET_ROWS)
     best = 1
     for d in range(1, seq + 1):
@@ -61,6 +66,15 @@ def pick_n_chunks(batch: int, seq: int) -> int:
             break
         if seq % d == 0:
             best = d
+    if best == 1 and batch * seq > 4 * _TARGET_ROWS:
+        import warnings
+
+        warnings.warn(
+            f"fused CE found no divisor of T={seq} under {cap}: running "
+            f"un-chunked ({batch * seq} logit rows at once). Pick a seq "
+            "len with small divisors to keep the memory win.",
+            stacklevel=2,
+        )
     return best
 
 
