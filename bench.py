@@ -158,7 +158,7 @@ def _operating_points(config: str, seq_len: int):
             return [(b0, 4), (max(1, b0 // 2), 6), (1, 8)]
         return [(12, 6), (16, 4), (8, 8), (4, 8), (2, 8), (1, 8)]
     if config == "hybrid_1b3":
-        return [(16, 4), (8, 6), (4, 6), (2, 6), (1, 6)]
+        return [(12, 6), (16, 4), (8, 6), (4, 6), (2, 6), (1, 6)]
     return [(16, None), (8, None), (4, None), (2, None), (1, None)]
 
 

@@ -1,0 +1,364 @@
+"""Pallas TPU fused Adafactor: the whole optimizer update — factored
+second-moment stats, update clipping, learning rate, finite-guard, and the
+parameter write — in three streaming passes per weight matrix.
+
+Why: the reference framework ships fused CUDA optimizers (reference:
+BASELINE.json north_star's torch/CUDA training stack; checkout never
+mounted — SURVEY.md §0); this is the TPU-native equivalent, built because
+the optimizer region profiled at ~120ms of the 1.3B flagship step.
+
+Measured outcome (v5e chip, b12 x skip6 flagship step — an HONEST
+NEGATIVE, kept as an option): 1778ms/step fused vs 1755ms for the optax
+chain. XLA already fuses the optax transforms close to the traffic floor
+(the q = s^2 g^2 + eps pass fuses with BOTH factored reduces, and the
+update pass rides the apply), while this version pays ~450 un-fusable
+custom-call launches (3 kernels x ~150 matrices). The default optimizer
+therefore stays "adafactor"; "adafactor_fused" remains available, exact,
+and tested — the economics may flip at other param/token ratios.
+
+Semantics are bit-compatible with the repo's optax configuration
+(``optax.adafactor(sched, min_dim_size_to_factor=128,
+multiply_by_parameter_scale=False)`` — training/trainer.py) composed with
+the Trainer's caller-side clip/finite fusion:
+
+    q          = (scale * g)^2 + eps            # scale folds clip + guard
+    v_row      = d_t * v_row + (1 - d_t) * mean(q, axis=d0)
+    v_col      = d_t * v_col + (1 - d_t) * mean(q, axis=d1)
+    u          = scale * g * (v_row / mean(v_row))^-1/2 * v_col^-1/2
+    u          = u / max(1, rms(u) / threshold)  # update clipping
+    p          = p - lr * u                      # skipped when non-finite
+    d_t        = 1 - (count + 1)^-0.8
+
+Three passes per factored matrix (the RMS term forces the split — rms(u)
+needs the completed v_row/v_col, and the apply needs rms(u)):
+  A: read G        -> axis-0 sums [n], axis-1 sums [m]      (stats)
+  B: read G        -> sum(u^2) scalar                        (clip RMS)
+  C: read G, P     -> write P' (aliased in-place)            (apply)
+G is read 3x and P 1x+1w ≈ 25GB at 1.3B — the streaming floor. Between
+passes, the EMA/factor math runs on [m]+[n] vectors in XLA (trivial).
+Non-factored leaves (1D / small / tile-misaligned) take an exact jnp
+replica of the optax formulas — negligible bytes.
+
+Single-device meshes only: a Mosaic custom call cannot be auto-partitioned
+by GSPMD (parallel/kernel_shard.py), and sharding the optimizer adds
+psums over the factored vectors — the multi-chip path keeps optax
+adafactor (training/trainer.py gates this).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+_DECAY = 0.8
+_EPS = 1e-30
+_CLIP = 1.0
+_MIN_FACTOR_DIM = 128
+
+
+class FusedAdafactorState(NamedTuple):
+    """Same per-leaf shapes (and memory) as optax's FactoredState."""
+
+    count: Array
+    v_row: Any
+    v_col: Any
+    v: Any
+
+
+def _factored_dims(shape) -> Optional[Tuple[int, int]]:
+    """optax._src.factorized._factored_dims (factored=True, min 128):
+    (d1, d0) = indices of the second-largest and largest axes."""
+    if len(shape) < 2:
+        return None
+    sorted_dims = np.argsort(shape)
+    if shape[sorted_dims[-2]] < _MIN_FACTOR_DIM:
+        return None
+    return int(sorted_dims[-2]), int(sorted_dims[-1])
+
+
+_MIN_KERNEL_ELEMS = 1 << 20  # tests lower this to force the kernel path
+
+
+def _kernel_ok(shape, dtype=jnp.float32) -> bool:
+    """2D fp32, tile aligned (rows % 8, lanes % 128), big enough to matter.
+    Non-fp32 leaves (param_dtype="bfloat16") take the jnp path, which casts
+    to f32 — the kernels' g*g and tile shapes assume fp32."""
+    return (
+        len(shape) == 2
+        and dtype == jnp.float32
+        and shape[0] % 8 == 0
+        and shape[1] % 128 == 0
+        and shape[0] * shape[1] >= _MIN_KERNEL_ELEMS
+    )
+
+
+def _row_block(m: int, n: int) -> int:
+    """Largest divisor of m (multiple of 8) keeping each [bm, n] fp32 block
+    ~<=1MB: the apply kernel holds three such blocks double-buffered, and
+    Mosaic's scoped-vmem stack is 16MB (hit at [32000, 2048] with bm=400)."""
+    cap = min(m, 512, max(8, (1 << 20) // (4 * n) // 8 * 8))
+    best = 8
+    for bm in range(8, cap + 1, 8):
+        if m % bm == 0:
+            best = bm
+    return best
+
+
+# -- kernels ----------------------------------------------------------------
+
+
+def _sums_kernel(eps: float, s2_ref, g_ref, s0_ref, s1_ref):
+    """Per row-tile: q = s^2 g^2 + eps; accumulate axis-0 sums, write
+    axis-1 sums."""
+    i = pl.program_id(0)
+    g = g_ref[...]
+    q = g * g * s2_ref[0, 0] + eps
+    s1_ref[...] = q.sum(axis=1, keepdims=True)
+
+    @pl.when(i == 0)
+    def _init():
+        s0_ref[...] = jnp.zeros_like(s0_ref)
+
+    s0_ref[...] += q.sum(axis=0, keepdims=True)
+
+
+def _rms_kernel(g_ref, r_ref, c_ref, acc_ref):
+    """sum(u^2) for the update-clipping RMS; scale folded into r."""
+    i = pl.program_id(0)
+    u = g_ref[...] * r_ref[...] * c_ref[...]
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += (u * u).sum().reshape(1, 1)
+
+
+def _apply_kernel(f_ref, g_ref, r_ref, c_ref, p_ref, out_ref):
+    """p' = p + g*r*c, or p untouched on a non-finite step (r folds
+    -lr * clip * scale)."""
+    p = p_ref[...]
+    u = g_ref[...] * r_ref[...] * c_ref[...]
+    out_ref[...] = jnp.where(f_ref[0, 0] > 0, p + u, p)
+
+
+def _pallas_sums(g: Array, s2: Array, eps: float, interpret: bool):
+    m, n = g.shape
+    bm = _row_block(m, n)
+    s0, s1 = pl.pallas_call(
+        functools.partial(_sums_kernel, eps),
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((m, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(s2.reshape(1, 1), g)
+    return s0.reshape(n), s1.reshape(m)
+
+
+def _pallas_rms(g: Array, r: Array, c: Array, interpret: bool) -> Array:
+    m, n = g.shape
+    bm = _row_block(m, n)
+    acc = pl.pallas_call(
+        _rms_kernel,
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        interpret=interpret,
+    )(g, r.reshape(m, 1), c.reshape(1, n))
+    return acc.reshape(())
+
+
+def _pallas_apply(g: Array, p: Array, r: Array, c: Array, finite: Array,
+                  interpret: bool) -> Array:
+    m, n = g.shape
+    bm = _row_block(m, n)
+    return pl.pallas_call(
+        _apply_kernel,
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, n), p.dtype),
+        input_output_aliases={4: 0},
+        interpret=interpret,
+    )(finite.astype(jnp.float32).reshape(1, 1), g, r.reshape(m, 1),
+      c.reshape(1, n), p)
+
+
+# -- per-leaf update --------------------------------------------------------
+
+
+def _leaf_update(g, p, v_row, v_col, v, *, decay_t, lr, scale, finite,
+                 eps, clip, use_kernel, interpret):
+    """One parameter tensor. Returns (new_p, new_v_row, new_v_col, new_v).
+
+    State selects (keep old on a non-finite step) happen here on the small
+    stat tensors; in the kernel path the param select rides inside the
+    apply kernel."""
+    dims = _factored_dims(g.shape)
+    keep = lambda new, old: jnp.where(finite, new, old)  # noqa: E731
+
+    if dims is None:
+        # exact optax non-factored path (small leaves: norm scales, biases)
+        q = (scale * g) ** 2 + eps
+        new_v = (decay_t * v + (1.0 - decay_t) * q).astype(p.dtype)
+        u = scale * g * jax.lax.rsqrt(new_v)
+        if clip:
+            u = u / jnp.maximum(1.0, jnp.sqrt(jnp.mean(u * u)) / clip)
+        new_p = jnp.where(finite, p - lr * u, p)
+        return new_p, v_row, v_col, keep(new_v, v)
+
+    d1, d0 = dims
+    fast = use_kernel and _kernel_ok(g.shape, g.dtype) and p.dtype == g.dtype
+    if not fast:
+        # exact optax factored path in jnp, any ndim (e.g. [E, D, H] MoE
+        # expert stacks) — the parity reference for the kernels below
+        q = (scale * g.astype(jnp.float32)) ** 2 + eps
+        new_v_row = (decay_t * v_row
+                     + (1.0 - decay_t) * q.mean(axis=d0)).astype(p.dtype)
+        new_v_col = (decay_t * v_col
+                     + (1.0 - decay_t) * q.mean(axis=d1)).astype(p.dtype)
+        reduced_d1 = d1 - 1 if d1 > d0 else d1
+        row_col_mean = jnp.mean(new_v_row, axis=reduced_d1, keepdims=True)
+        row_factor = jax.lax.rsqrt(new_v_row / row_col_mean)
+        col_factor = jax.lax.rsqrt(new_v_col)
+        u = (scale * g.astype(jnp.float32)
+             * jnp.expand_dims(row_factor, d0)
+             * jnp.expand_dims(col_factor, d1))
+        if clip:
+            u = u / jnp.maximum(1.0, jnp.sqrt(jnp.mean(u * u)) / clip)
+        new_p = jnp.where(finite, (p - lr * u).astype(p.dtype), p)
+        return new_p, keep(new_v_row, v_row), keep(new_v_col, v_col), v
+
+    m, n = g.shape
+    s2 = (scale * scale).astype(jnp.float32)
+    sum0, sum1 = _pallas_sums(g, s2, eps, interpret)  # [n], [m]
+
+    # optax: v_row = mean over axis d0, v_col = mean over axis d1
+    mean_d0 = (sum1 / n) if d0 == 1 else (sum0 / m)   # shape: del(d0)
+    mean_d1 = (sum0 / m) if d1 == 0 else (sum1 / n)   # shape: del(d1)
+    new_v_row = (decay_t * v_row + (1.0 - decay_t) * mean_d0).astype(p.dtype)
+    new_v_col = (decay_t * v_col + (1.0 - decay_t) * mean_d1).astype(p.dtype)
+    row_factor = jax.lax.rsqrt(new_v_row / jnp.mean(new_v_row))
+    col_factor = jax.lax.rsqrt(new_v_col)
+
+    # u[i,j] = scale * g[i,j] * row_factor[expand d0] * col_factor[expand d1]
+    # -> express as g * rvec[m] * cvec[n]
+    if d0 == 1:  # row_factor along axis0 [m], col_factor along axis1 [n]
+        rvec, cvec = row_factor, col_factor
+    else:        # row_factor along axis1 [n], col_factor along axis0 [m]
+        rvec, cvec = col_factor, row_factor
+    rvec_s = rvec.astype(jnp.float32) * scale
+    cvec32 = cvec.astype(jnp.float32)
+
+    sum_u2 = _pallas_rms(g, rvec_s, cvec32, interpret)
+    kappa = -lr
+    if clip:
+        kappa = kappa / jnp.maximum(1.0, jnp.sqrt(sum_u2 / (m * n)) / clip)
+    new_p = _pallas_apply(g, p, rvec_s * kappa, cvec32, finite, interpret)
+    return new_p, keep(new_v_row, v_row), keep(new_v_col, v_col), v
+
+
+# -- public API -------------------------------------------------------------
+
+
+def init(params) -> FusedAdafactorState:
+    """Mirror of optax.adafactor's state shapes (FactoredState)."""
+
+    def _init(p):
+        dims = _factored_dims(p.shape)
+        if dims is not None:
+            d1, d0 = dims
+            vr = jnp.zeros(np.delete(p.shape, d0), p.dtype)
+            vc = jnp.zeros(np.delete(p.shape, d1), p.dtype)
+            return vr, vc, jnp.zeros((1,), p.dtype)
+        return (jnp.zeros((1,), p.dtype), jnp.zeros((1,), p.dtype),
+                jnp.zeros(p.shape, p.dtype))
+
+    leaves = jax.tree.map(_init, params)
+    pick = lambda i: jax.tree.map(  # noqa: E731
+        lambda t: t[i], leaves, is_leaf=lambda t: isinstance(t, tuple)
+    )
+    return FusedAdafactorState(
+        count=jnp.zeros((), jnp.int32),
+        v_row=pick(0), v_col=pick(1), v=pick(2),
+    )
+
+
+def apply_updates(
+    grads, params, state: FusedAdafactorState, *, lr, scale, finite,
+    decay_rate: float = _DECAY, eps: float = _EPS,
+    clipping_threshold: Optional[float] = _CLIP,
+    backend: str = "auto",
+):
+    """(new_params, new_state). ``scale`` folds the caller's grad clip and
+    finite guard exactly like Trainer._train_step's safe_grads; ``finite``
+    keeps params AND stats untouched on a bad step (the skip policy)."""
+    if backend == "auto":
+        try:
+            plat = jax.devices()[0].platform
+        except RuntimeError:
+            plat = "cpu"
+        backend = "pallas" if plat == "tpu" else "jnp"
+    use_kernel = backend in ("pallas", "interpret")
+    interpret = backend == "interpret"
+
+    t = jnp.asarray(state.count + 1, jnp.float32)
+    decay_t = 1.0 - t ** (-decay_rate)
+    lr = jnp.asarray(lr, jnp.float32)
+    scale = jnp.asarray(scale, jnp.float32)
+    finite = jnp.asarray(finite)
+
+    flat_g, treedef = jax.tree.flatten(grads)
+    flat_p = treedef.flatten_up_to(params)
+    flat_vr = treedef.flatten_up_to(state.v_row)
+    flat_vc = treedef.flatten_up_to(state.v_col)
+    flat_v = treedef.flatten_up_to(state.v)
+    out_p, out_vr, out_vc, out_v = [], [], [], []
+    for g, p, vr, vc, v in zip(flat_g, flat_p, flat_vr, flat_vc, flat_v):
+        np_, nvr, nvc, nv = _leaf_update(
+            g, p, vr, vc, v, decay_t=decay_t, lr=lr, scale=scale,
+            finite=finite, eps=eps, clip=clipping_threshold,
+            use_kernel=use_kernel, interpret=interpret,
+        )
+        out_p.append(np_)
+        out_vr.append(nvr)
+        out_vc.append(nvc)
+        out_v.append(nv)
+    new_state = FusedAdafactorState(
+        # good-step count: the optax twin's counts live inside the state the
+        # Trainer rolls back wholesale on a non-finite step, so a skipped
+        # step must not advance decay_t / the lr schedule here either
+        count=state.count + finite.astype(state.count.dtype),
+        v_row=jax.tree.unflatten(treedef, out_vr),
+        v_col=jax.tree.unflatten(treedef, out_vc),
+        v=jax.tree.unflatten(treedef, out_v),
+    )
+    return jax.tree.unflatten(treedef, out_p), new_state

@@ -153,11 +153,15 @@ def make_optimizer(
             sched, b1=cfg.b1, b2=cfg.b2,
             weight_decay=cfg.weight_decay, mask=_wd_mask, mu_dtype=mu_dtype,
         )
-    elif cfg.optimizer == "adafactor":
+    elif cfg.optimizer in ("adafactor", "adafactor_fused"):
         # factored second moment (O(n+m) state per matrix): the single-chip
         # memory-headroom option for 1.3B+ (SURVEY §7 "bigger-batch").
         # No decoupled weight decay — standard adafactor usage; its
         # update-clipping plays the stabilizing role.
+        # "adafactor_fused" normally runs the Pallas fused update inside
+        # Trainer (ops/pallas/adafactor.py) and never touches this chain;
+        # this optax twin is the documented fallback for every other
+        # make_optimizer caller (train_lra, multi-device Trainer meshes).
         opt = optax.adafactor(
             sched, min_dim_size_to_factor=128,
             multiply_by_parameter_scale=False,
@@ -324,7 +328,31 @@ class Trainer:
                 f"pp_microbatches={self.pp_n_micro} must divide the "
                 f"{'per-shard ' if fm else ''}per-accumulation batch {base}"
             )
+        # Pallas fused adafactor (ops/pallas/adafactor.py): single-device
+        # meshes only — GSPMD cannot auto-partition a Mosaic custom call
+        # (parallel/kernel_shard.py), and the factored stats would need
+        # psums; multi-device meshes fall back to the optax twin.
+        self._fused_opt = cfg.optimizer == "adafactor_fused"
+        if self._fused_opt and (self.mesh.devices.size > 1 or self.pp > 1):
+            # a silent optax fallback would make the opt_state checkpoint
+            # pytree depend on mesh size (FusedAdafactorState vs the optax
+            # chain tuple), breaking restore across mesh changes — the one
+            # thing the cross-mesh restore tests guarantee. Fail loudly;
+            # multi-chip runs use optimizer="adafactor".
+            raise ValueError(
+                "optimizer='adafactor_fused' runs on single-device meshes "
+                "only (Mosaic custom calls cannot be auto-partitioned by "
+                "GSPMD); use optimizer='adafactor' on multi-device meshes"
+            )
         self.tx = make_optimizer(cfg, include_clip=False)
+        if self._fused_opt:
+            from orion_tpu.ops.pallas import adafactor as _fused_af
+
+            self._fused_af = _fused_af
+            self.tx = optax.GradientTransformation(
+                init=_fused_af.init,
+                update=None,  # the fused path never calls tx.update
+            )
         self.sched = make_schedule(cfg)
         self.batch_shd = batch_sharding(self.mesh)
 
@@ -431,20 +459,34 @@ class Trainer:
         )
         # where (not *): a NaN gnorm must select 0, not propagate
         scale = jnp.where(finite, clip, 0.0)
-        safe_grads = jax.tree.map(lambda g: g * scale, grads)
-        updates, new_opt = self.tx.update(
-            safe_grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
-        # skip-policy: on a non-finite step keep the old params & opt state
-        sel = lambda new, old: jax.tree.map(  # noqa: E731
-            lambda n, o: jnp.where(finite, n, o), new, old
-        )
         bad = (~finite).astype(jnp.int32)
+        if self._fused_opt:
+            # the fused kernels fold the scale, the lr, the update clip,
+            # AND the skip-policy select (ops/pallas/adafactor.py)
+            # lr indexed by the GOOD-step count (state.opt_state.count),
+            # matching the optax twin whose schedule count is rolled back
+            # with the rest of the state on non-finite steps
+            new_params, new_opt = self._fused_af.apply_updates(
+                grads, state.params, state.opt_state,
+                lr=self.sched(state.opt_state.count), scale=scale,
+                finite=finite,
+            )
+        else:
+            safe_grads = jax.tree.map(lambda g: g * scale, grads)
+            updates, new_opt = self.tx.update(
+                safe_grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+            # skip-policy: on a non-finite step keep the old params & state
+            sel = lambda new, old: jax.tree.map(  # noqa: E731
+                lambda n, o: jnp.where(finite, n, o), new, old
+            )
+            new_params = sel(new_params, state.params)
+            new_opt = sel(new_opt, state.opt_state)
         new_state = TrainState(
             step=state.step + 1,
-            params=sel(new_params, state.params),
-            opt_state=sel(new_opt, state.opt_state),
+            params=new_params,
+            opt_state=new_opt,
             rng=state.rng,
             nonfinite=state.nonfinite + bad,
         )

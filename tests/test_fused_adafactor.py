@@ -1,0 +1,193 @@
+"""Pallas fused adafactor (ops/pallas/adafactor.py) vs the optax chain it
+replaces — state-shape, update, skip-policy, and Trainer-level parity.
+(Reference optimizer: the repo's optax.adafactor configuration,
+training/trainer.py::make_optimizer; reference checkout never mounted —
+SURVEY.md §0.)"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import orion_tpu.ops.pallas.adafactor as FA
+
+
+def _tree(seed=0):
+    k = jax.random.split(jax.random.PRNGKey(seed), 6)
+    return {
+        "wide": jax.random.normal(k[0], (128, 256)) * 0.3,   # n > m
+        "tall": jax.random.normal(k[1], (256, 128)) * 0.1,   # m > n
+        "square": jax.random.normal(k[2], (128, 128)),
+        "bias": jax.random.normal(k[3], (256,)),             # non-factored
+        "small": jax.random.normal(k[4], (16, 64)),          # dims < 128
+        "expert": jax.random.normal(k[5], (2, 128, 192)),    # 3D (MoE-like)
+    }
+
+
+def _optax_reference(lr=1e-2):
+    return optax.adafactor(
+        lr, min_dim_size_to_factor=128, multiply_by_parameter_scale=False
+    )
+
+
+def _optax_step(tx, opt_state, params, grads, scale, finite):
+    """The Trainer's exact unfused semantics: scaled grads, update, apply,
+    skip-policy select (training/trainer.py::_train_step)."""
+    safe = jax.tree.map(lambda g: g * scale, grads)
+    updates, new_opt = tx.update(safe, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    sel = lambda new, old: jax.tree.map(
+        lambda a, b: jnp.where(finite, a, b), new, old
+    )
+    return sel(new_params, params), sel(new_opt, opt_state)
+
+
+def test_state_shapes_match_optax():
+    params = _tree()
+    ours = FA.init(params)
+    theirs = _optax_reference().init(params)
+    # optax chain state: (FactoredState, clip/schedule states, ...)
+    fac = theirs[0]
+    for key in params:
+        assert ours.v_row[key].shape == fac.v_row[key].shape, key
+        assert ours.v_col[key].shape == fac.v_col[key].shape, key
+        assert ours.v[key].shape == fac.v[key].shape, key
+
+
+@pytest.mark.parametrize("backend", ["jnp", "interpret"])
+def test_update_parity_multi_step(backend, monkeypatch):
+    monkeypatch.setattr(FA, "_MIN_KERNEL_ELEMS", 0)
+    lr = 3e-3
+    params = _tree()
+    grads_seq = [_tree(seed=10 + i) for i in range(3)]
+
+    tx = _optax_reference(lr)
+    o_params, o_state = params, tx.init(params)
+    f_params, f_state = params, FA.init(params)
+    one = jnp.float32(1.0)
+    finite = jnp.bool_(True)
+    for i, g in enumerate(grads_seq):
+        scale = jnp.float32(1.0 if i != 1 else 0.37)  # a binding-clip step
+        o_params, o_state = _optax_step(tx, o_state, o_params, g, scale, finite)
+        f_params, f_state = FA.apply_updates(
+            g, f_params, f_state, lr=lr, scale=scale, finite=finite,
+            backend=backend,
+        )
+        for key in params:
+            np.testing.assert_allclose(
+                f_params[key], o_params[key], rtol=2e-5, atol=1e-7,
+                err_msg=f"step {i} leaf {key}",
+            )
+    fac = o_state[0]
+    for key in params:
+        np.testing.assert_allclose(
+            f_state.v_row[key], fac.v_row[key], rtol=2e-5, atol=1e-9, err_msg=key
+        )
+        np.testing.assert_allclose(
+            f_state.v_col[key], fac.v_col[key], rtol=2e-5, atol=1e-9, err_msg=key
+        )
+        np.testing.assert_allclose(
+            f_state.v[key], fac.v[key], rtol=2e-5, atol=1e-9, err_msg=key
+        )
+    assert int(f_state.count) == 3
+
+
+@pytest.mark.parametrize("backend", ["jnp", "interpret"])
+def test_nonfinite_step_keeps_everything(backend, monkeypatch):
+    monkeypatch.setattr(FA, "_MIN_KERNEL_ELEMS", 0)
+    params = _tree()
+    state = FA.init(params)
+    g = _tree(seed=42)
+    g["tall"] = g["tall"].at[0, 0].set(jnp.nan)
+    new_p, new_s = FA.apply_updates(
+        g, params, state, lr=1e-2, scale=jnp.float32(0.0),
+        finite=jnp.bool_(False), backend=backend,
+    )
+    for key in params:
+        np.testing.assert_array_equal(new_p[key], params[key], err_msg=key)
+        np.testing.assert_array_equal(
+            new_s.v_row[key], state.v_row[key], err_msg=key
+        )
+        np.testing.assert_array_equal(new_s.v[key], state.v[key], err_msg=key)
+    # good-step count: a skipped step must not advance decay_t / the lr
+    # schedule (the optax twin's counts are rolled back by the Trainer's
+    # state select)
+    assert int(new_s.count) == 0
+
+
+def test_parity_across_a_nonfinite_step():
+    # good step -> NaN step (skipped) -> good step: both paths must agree,
+    # including the decay/lr schedule position after the rollback
+    lr = 1e-2
+    params = _tree()
+    tx = _optax_reference(lr)
+    o_params, o_state = params, tx.init(params)
+    f_params, f_state = params, FA.init(params)
+    steps = [
+        (_tree(seed=20), jnp.float32(1.0), jnp.bool_(True)),
+        (jax.tree.map(lambda x: x * jnp.nan, _tree(seed=21)),
+         jnp.float32(0.0), jnp.bool_(False)),
+        (_tree(seed=22), jnp.float32(1.0), jnp.bool_(True)),
+    ]
+    for g, scale, finite in steps:
+        o_params, o_state = _optax_step(tx, o_state, o_params, g, scale, finite)
+        f_params, f_state = FA.apply_updates(
+            g, f_params, f_state, lr=lr, scale=scale, finite=finite,
+            backend="jnp",
+        )
+    for key in params:
+        np.testing.assert_allclose(
+            f_params[key], o_params[key], rtol=2e-5, atol=1e-7, err_msg=key
+        )
+    assert int(f_state.count) == 2  # two good steps
+
+
+def test_update_parity_under_jit(monkeypatch):
+    monkeypatch.setattr(FA, "_MIN_KERNEL_ELEMS", 0)
+    params = _tree()
+    g = _tree(seed=7)
+    state = FA.init(params)
+
+    @jax.jit
+    def step(g, p, s):
+        return FA.apply_updates(
+            g, p, s, lr=1e-2, scale=jnp.float32(1.0),
+            finite=jnp.bool_(True), backend="interpret",
+        )
+
+    jp, js = step(g, params, state)
+    ep, es = FA.apply_updates(
+        g, params, state, lr=1e-2, scale=jnp.float32(1.0),
+        finite=jnp.bool_(True), backend="jnp",
+    )
+    for key in params:
+        np.testing.assert_allclose(jp[key], ep[key], rtol=2e-5, atol=1e-7)
+
+
+def test_trainer_fused_matches_optax_adafactor():
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.parallel.mesh import MeshConfig
+    from orion_tpu.training.data import SyntheticDataset
+    from orion_tpu.training.trainer import TrainConfig, Trainer
+
+    model = dataclasses.replace(get_config("tiny"), max_seq_len=64)
+    kw = dict(model=model, steps=4, batch_size=2, seq_len=64, lr=1e-3,
+              warmup_steps=2, mesh=MeshConfig(dp=1), log_every=10**9,
+              mu_dtype=None)
+    data = SyntheticDataset(model.vocab_size, 64)
+    batches = [jnp.asarray(data.batch(0, i, 2)) for i in range(3)]
+
+    results = {}
+    for opt in ("adafactor", "adafactor_fused"):
+        tr = Trainer(TrainConfig(optimizer=opt, **kw))
+        for b in batches:
+            m = tr.step(b)
+        results[opt] = (tr.state.params, float(m["loss"]))
+    pa, la = results["adafactor"]
+    pf, lf = results["adafactor_fused"]
+    assert abs(la - lf) < 1e-5
+    for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pf)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
