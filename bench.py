@@ -159,6 +159,8 @@ def _operating_points(config: str, seq_len: int):
         return [(12, 6), (16, 4), (8, 8), (4, 8), (2, 8), (1, 8)]
     if config == "hybrid_1b3":
         return [(12, 6), (16, 4), (8, 6), (4, 6), (2, 6), (1, 6)]
+    if config == "moe_1b3_4e":  # expert weights shrink the skip budget
+        return [(12, 4), (16, 0), (8, 4), (4, 4), (2, 4), (1, 4)]
     return [(16, None), (8, None), (4, None), (2, None), (1, None)]
 
 

@@ -25,9 +25,19 @@ def lm_eval_sums(model: TransformerLM, params, batch, logits_fn=None):
     eval-loss definition — Trainer._eval_step delegates here too, so the
     periodic in-training eval and this CLI can never drift apart.
     ``logits_fn(model, params, x)`` overrides the forward (the pp Trainer
-    passes the pipelined one); default is the plain parallel forward."""
+    passes the pipelined one); default is the fused-CE chunked forward
+    (ops/fused_ce.py — same numbers, no [B, T, V] fp32 logits, so eval
+    fits wherever training does, e.g. T=32k on one chip)."""
     x, y = batch[:, :-1], batch[:, 1:]
-    logits = model.apply(params, x) if logits_fn is None else logits_fn(model, params, x)
+    if logits_fn is None:
+        from orion_tpu.ops.fused_ce import fused_ce_ok, model_token_losses
+
+        if fused_ce_ok(model):
+            losses, _ = model_token_losses(model, params, x, y)
+            return losses.sum(), jnp.asarray(losses.size, jnp.float32)
+        logits = model.apply(params, x)
+    else:
+        logits = logits_fn(model, params, x)
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
     return losses.sum(), jnp.asarray(losses.size, jnp.float32)
 

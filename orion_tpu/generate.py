@@ -80,8 +80,10 @@ def _generate_jit(
     """prompt [B, T0] -> generated [B, max_new_tokens]."""
     t0 = prompt.shape[1]
     use_eos = sample_cfg.eos_token >= 0
-    logits, states = model.apply(params, prompt, method="prefill")
-    first = sample_logits(logits[:, -1], jax.random.fold_in(rng, 0), sample_cfg)
+    # last-position-only head: the full-prompt [B, T, V] logits would cost
+    # a T x D x V matmul + 4.3GB fp32 at T=32k for values generation drops
+    logits, states = model.apply(params, prompt, method="prefill_last")
+    first = sample_logits(logits, jax.random.fold_in(rng, 0), sample_cfg)
     done0 = jnp.zeros(first.shape, bool)
 
     def body(carry, i):

@@ -634,6 +634,21 @@ class TransformerLM(nn.Module):
             states.append(st)
         return self._head(x), states
 
+    def prefill_last(self, tokens: Array) -> Tuple[Array, List[State]]:
+        """prefill, but the head matmul runs on the LAST position only ->
+        (logits [B, V], states). Generation needs nothing else, and the
+        full-prompt head is the difference between a [B, T, V] fp32 tensor
+        (4.3GB at T=32k) and a [B, V] row — long-prompt serving fits
+        because of this (generate.py uses it; ``prefill`` keeps the full
+        contract for parity tests and scoring)."""
+        t = tokens.shape[-1]
+        x = self._embed(tokens, jnp.arange(t))
+        states = []
+        for blk in self.blocks:
+            x, st = blk.prefill(x)
+            states.append(st)
+        return self._head(x[:, -1:, :])[:, 0], states
+
     def decode_step(
         self, token: Array, states: List[State], t: Array
     ) -> Tuple[Array, List[State]]:

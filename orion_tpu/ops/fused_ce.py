@@ -44,7 +44,51 @@ import numpy as np
 
 Array = jax.Array
 
-__all__ = ["fused_linear_cross_entropy", "pick_n_chunks"]
+__all__ = [
+    "fused_linear_cross_entropy", "pick_n_chunks", "fused_ce_ok",
+    "model_token_losses",
+]
+
+
+def fused_ce_ok(model) -> bool:
+    """Is the fused head+CE path applicable to this model? Everywhere
+    except: sp meshes (the T-chunked scan would slice across the token
+    sharding — the unfused head lowers cleanly there) and quantized models
+    (decode-only path, never trained/evaled through here)."""
+    if getattr(model, "quant", ""):
+        return False
+    if (
+        model.cfg.sequence_parallel
+        and model.mesh is not None
+        and model.mesh.shape.get("sp", 1) > 1
+    ):
+        return False
+    return True
+
+
+def model_token_losses(model, params, x: Array, y: Array,
+                       mutable: bool = False, **apply_kwargs):
+    """Per-token next-token CE [B, T] through the fused head — the ONE
+    invocation of this path, shared by the training loss
+    (training/trainer.py::lm_loss) and the eval loss
+    (evaluate.py::lm_eval_sums) so the two can never drift.
+    Returns (losses, variables) — variables is the sowed "losses"
+    collection when ``mutable`` (MoE aux), else {}."""
+    from orion_tpu.models.transformer import _dtype
+
+    if mutable:
+        feats, variables = model.apply(
+            params, x, mutable="losses", method="features", **apply_kwargs
+        )
+    else:
+        feats = model.apply(params, x, method="features", **apply_kwargs)
+        variables = {}
+    w, w_is_vd = model.head_weight(params)
+    feats = feats.astype(_dtype(model.cfg.dtype))
+    losses = fused_linear_cross_entropy(
+        feats, w, y, pick_n_chunks(*y.shape), w_is_vd
+    )
+    return losses, variables
 
 # ~rows of each chunk matmul: big enough to fill the MXU (>=8 sublane tiles
 # of 8x128 per 128-row pass), small enough that the [rows, V] fp32 logits

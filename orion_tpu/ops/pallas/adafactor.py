@@ -41,8 +41,9 @@ replica of the optax formulas — negligible bytes.
 
 Single-device meshes only: a Mosaic custom call cannot be auto-partitioned
 by GSPMD (parallel/kernel_shard.py), and sharding the optimizer adds
-psums over the factored vectors — the multi-chip path keeps optax
-adafactor (training/trainer.py gates this).
+psums over the factored vectors — Trainer REJECTS this option on
+multi-device meshes (no silent fallback: the opt_state checkpoint pytree
+must not depend on mesh size); configure optimizer="adafactor" there.
 """
 
 from __future__ import annotations

@@ -158,10 +158,13 @@ def make_optimizer(
         # memory-headroom option for 1.3B+ (SURVEY §7 "bigger-batch").
         # No decoupled weight decay — standard adafactor usage; its
         # update-clipping plays the stabilizing role.
-        # "adafactor_fused" normally runs the Pallas fused update inside
-        # Trainer (ops/pallas/adafactor.py) and never touches this chain;
-        # this optax twin is the documented fallback for every other
-        # make_optimizer caller (train_lra, multi-device Trainer meshes).
+        # "adafactor_fused" runs the Pallas fused update inside Trainer
+        # (ops/pallas/adafactor.py) and never touches this chain; this
+        # optax twin serves the OTHER make_optimizer callers (train_lra's
+        # shim). A multi-device Trainer mesh does NOT fall back — it
+        # rejects the fused option loudly (see __init__), because a silent
+        # downgrade would change the opt_state checkpoint pytree with mesh
+        # size.
         opt = optax.adafactor(
             sched, min_dim_size_to_factor=128,
             multiply_by_parameter_scale=False,
@@ -183,20 +186,7 @@ def make_optimizer(
     return optax.chain(*chain)
 
 
-def _fused_ce_ok(model: TransformerLM) -> bool:
-    """The fused head+CE path (ops/fused_ce.py) applies everywhere except:
-    sp meshes (its T-chunked scan would slice across the token sharding —
-    the unfused head lowers cleanly there) and quantized models (decode-only
-    path, never trained)."""
-    if getattr(model, "quant", ""):
-        return False
-    if (
-        model.cfg.sequence_parallel
-        and model.mesh is not None
-        and model.mesh.shape.get("sp", 1) > 1
-    ):
-        return False
-    return True
+from orion_tpu.ops.fused_ce import fused_ce_ok as _fused_ce_ok  # shared gate
 
 
 def lm_loss(
@@ -216,17 +206,10 @@ def lm_loss(
     if fused_ce is None:
         fused_ce = _fused_ce_ok(model)
     if fused_ce:
-        from orion_tpu.ops.fused_ce import (
-            fused_linear_cross_entropy, pick_n_chunks,
-        )
+        from orion_tpu.ops.fused_ce import model_token_losses
 
-        feats, variables = model.apply(
-            params, x, mutable="losses", method="features", **kwargs
-        )
-        w, w_is_vd = model.head_weight(params)
-        feats = feats.astype(_dtype(model.cfg.dtype))
-        losses = fused_linear_cross_entropy(
-            feats, w, y, pick_n_chunks(*y.shape), w_is_vd
+        losses, variables = model_token_losses(
+            model, params, x, y, mutable=True, **kwargs
         )
     else:
         logits, variables = model.apply(params, x, mutable="losses", **kwargs)
@@ -344,7 +327,6 @@ class Trainer:
                 "only (Mosaic custom calls cannot be auto-partitioned by "
                 "GSPMD); use optimizer='adafactor' on multi-device meshes"
             )
-        self.tx = make_optimizer(cfg, include_clip=False)
         if self._fused_opt:
             from orion_tpu.ops.pallas import adafactor as _fused_af
 
@@ -353,6 +335,8 @@ class Trainer:
                 init=_fused_af.init,
                 update=None,  # the fused path never calls tx.update
             )
+        else:
+            self.tx = make_optimizer(cfg, include_clip=False)
         self.sched = make_schedule(cfg)
         self.batch_shd = batch_sharding(self.mesh)
 

@@ -113,6 +113,43 @@ def test_lm_loss_fused_matches_unfused():
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
 
 
+def test_lm_eval_sums_fused_matches_logits_path():
+    from orion_tpu.evaluate import lm_eval_sums
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+
+    cfg = get_config("tiny")
+    model = TransformerLM(cfg)
+    batch = jax.random.randint(
+        jax.random.PRNGKey(3), (2, 33), 0, cfg.vocab_size
+    )
+    params = model.init(jax.random.PRNGKey(4), batch[:, :-1])
+    s_fused, c_fused = lm_eval_sums(model, params, batch)
+    # the explicit-logits override is the unfused reference
+    s_ref, c_ref = lm_eval_sums(
+        model, params, batch, logits_fn=lambda m, p, x: m.apply(p, x)
+    )
+    np.testing.assert_allclose(s_fused, s_ref, rtol=1e-6)
+    assert float(c_fused) == float(c_ref)
+
+
+def test_prefill_last_matches_full_prefill():
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+
+    # hybrid layers so swa/softmax decode states are covered too
+    cfg = get_config("tiny", n_layers=3, layer_types=("linear", "swa", "softmax"),
+                     window=8, backend="xla")
+    model = TransformerLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(6), toks)
+    full, st_full = model.apply(params, toks, method="prefill")
+    last, st_last = model.apply(params, toks, method="prefill_last")
+    np.testing.assert_allclose(last, full[:, -1], rtol=1e-6, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(st_full), jax.tree.leaves(st_last)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
 def test_lm_loss_fused_moe_aux_preserved():
     # MoE models sow aux losses in the "losses" collection; the fused path
     # must collect them exactly like the unfused one
