@@ -227,20 +227,31 @@ def _decode_model(config: str, prompt_len: int, n_tokens: int,
     import jax
     import jax.numpy as jnp
 
-    from orion_tpu.generate import quantize_for_decode
     from orion_tpu.models.configs import get_config
     from orion_tpu.models.transformer import TransformerLM
 
     cfg = get_config(config, max_seq_len=max(prompt_len + n_tokens + 8, 512))
-    model = TransformerLM(cfg)
     prompt = jnp.ones((1, prompt_len), jnp.int32)
+    if quant:
+        # init the QUANTIZED module tree directly (int8 tables + fp32
+        # scales) instead of materializing fp32 weights first and
+        # converting: at 7B the fp32 staging alone (26GB) exceeds both the
+        # chip and any reasonable host detour — int8-direct is what makes
+        # the one-chip 7B serving row below possible at all
+        qmodel = TransformerLM(cfg, quant=quant)
+        qparams = jax.eval_shape(qmodel.init, jax.random.PRNGKey(0), prompt)
+        qparams = jax.tree.map(
+            lambda s: jnp.full(
+                s.shape, 1 if s.dtype == jnp.int8 else 0.01, s.dtype
+            ),
+            qparams,
+        )
+        return qmodel, qparams
+    model = TransformerLM(cfg)
     params = jax.eval_shape(model.init, jax.random.PRNGKey(0), prompt)
     params = jax.tree.map(
         lambda s: jnp.full(s.shape, 0.01, s.dtype), params
     )
-    if quant:
-        qmodel, qparams = quantize_for_decode(model, params)
-        return qmodel, qparams
     return model, params
 
 
@@ -417,6 +428,12 @@ def main(argv=None) -> int:
              dict(config="hybrid_1b3", prompt_len=512, n_tokens=32)),
             ("decode_p50_ms_per_token_hybrid1b3_b1_p512_int8",
              dict(config="hybrid_1b3", prompt_len=512, n_tokens=32,
+                  quant="int8")),
+            # the one-chip 7B serving row: 6.62B params fit the 16GB v5e
+            # ONLY as an int8 stream (6.6GB vs 26GB fp32) — int8-direct
+            # init above makes this buildable without fp32 staging
+            ("decode_p50_ms_per_token_hybrid7b_b1_p512_int8",
+             dict(config="hybrid_7b", prompt_len=512, n_tokens=32,
                   quant="int8")),
         ]:
             try:

@@ -624,14 +624,19 @@ class TransformerLM(nn.Module):
             return p["embed"]["embedding"], True
         return p["lm_head_kernel"], False
 
-    def prefill(self, tokens: Array) -> Tuple[Array, List[State]]:
-        """tokens [B, T] -> (logits [B, T, V], per-layer decode states)."""
+    def _prefill_trunk(self, tokens: Array) -> Tuple[Array, List[State]]:
+        """Shared embed + per-block state-collecting forward -> (x, states)."""
         t = tokens.shape[-1]
         x = self._embed(tokens, jnp.arange(t))
         states = []
         for blk in self.blocks:
             x, st = blk.prefill(x)
             states.append(st)
+        return x, states
+
+    def prefill(self, tokens: Array) -> Tuple[Array, List[State]]:
+        """tokens [B, T] -> (logits [B, T, V], per-layer decode states)."""
+        x, states = self._prefill_trunk(tokens)
         return self._head(x), states
 
     def prefill_last(self, tokens: Array) -> Tuple[Array, List[State]]:
@@ -641,12 +646,7 @@ class TransformerLM(nn.Module):
         (4.3GB at T=32k) and a [B, V] row — long-prompt serving fits
         because of this (generate.py uses it; ``prefill`` keeps the full
         contract for parity tests and scoring)."""
-        t = tokens.shape[-1]
-        x = self._embed(tokens, jnp.arange(t))
-        states = []
-        for blk in self.blocks:
-            x, st = blk.prefill(x)
-            states.append(st)
+        x, states = self._prefill_trunk(tokens)
         return self._head(x[:, -1:, :])[:, 0], states
 
     def decode_step(

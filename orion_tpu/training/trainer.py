@@ -314,7 +314,8 @@ class Trainer:
         # Pallas fused adafactor (ops/pallas/adafactor.py): single-device
         # meshes only — GSPMD cannot auto-partition a Mosaic custom call
         # (parallel/kernel_shard.py), and the factored stats would need
-        # psums; multi-device meshes fall back to the optax twin.
+        # psums. Multi-device meshes are REJECTED below, not silently
+        # downgraded: the opt_state pytree must not depend on mesh size.
         self._fused_opt = cfg.optimizer == "adafactor_fused"
         if self._fused_opt and (self.mesh.devices.size > 1 or self.pp > 1):
             # a silent optax fallback would make the opt_state checkpoint
