@@ -160,7 +160,10 @@ def _operating_points(config: str, seq_len: int):
     if config == "hybrid_1b3":
         return [(12, 6), (16, 4), (8, 6), (4, 6), (2, 6), (1, 6)]
     if config == "moe_1b3_4e":  # expert weights shrink the skip budget
-        return [(12, 4), (16, 0), (8, 4), (4, 4), (2, 4), (1, 4)]
+        # monotone by expected footprint: after a (12,4) OOM a LARGER batch
+        # cannot fit either (ADVICE r3 #4 — the old (16,0) entry here just
+        # burned a compile cycle on the way down)
+        return [(12, 4), (8, 4), (4, 4), (2, 4), (1, 4)]
     return [(16, None), (8, None), (4, None), (2, None), (1, None)]
 
 

@@ -31,6 +31,7 @@ from orion_tpu.training.trainer import TrainConfig, Trainer
 def train(
     cfg: TrainConfig,
     data: str = "synthetic",
+    eval_data: Optional[str] = None,
     log_path: Optional[str] = None,
     resume: bool = True,
 ) -> Tuple[object, dict]:
@@ -60,9 +61,26 @@ def train(
     )
     logger = MetricsLogger(log_path)
     eval_loader = None
+    if eval_data and not cfg.eval_every:
+        raise ValueError(
+            "eval_data given but eval_every == 0 — the held-out split "
+            "would silently never be evaluated; set eval_every > 0 "
+            "(CLI: --eval-every N)"
+        )
     if cfg.eval_every:
+        # a real held-out split when given (--eval-data val.bin); otherwise
+        # a disjoint-seed stream over the training data
+        eval_ds = (
+            make_dataset(eval_data, cfg.seq_len, cfg.model.vocab_size)
+            if eval_data
+            else dataset
+        )
+        assert eval_ds.vocab_size <= cfg.model.vocab_size, (
+            f"eval data vocab {eval_ds.vocab_size} > model vocab "
+            f"{cfg.model.vocab_size}"
+        )
         eval_loader = DataLoader(
-            dataset, cfg.batch_size, seed=cfg.seed + 1,
+            eval_ds, cfg.batch_size, seed=cfg.seed + 1,
             start_step=10_000_000, sharding=trainer.batch_shd,
         )
     try:
@@ -85,6 +103,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("orion_tpu.train")
     p.add_argument("--config", default="tiny", help="named model config")
     p.add_argument("--data", default="synthetic", help="'synthetic' or token-bin path")
+    p.add_argument("--eval-data", default=None,
+                   help="held-out token-bin path for eval (default: train data)")
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="eval cadence in steps (0 = no interleaved eval)")
     p.add_argument("--steps", type=int, default=1000)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=256)
@@ -125,6 +147,7 @@ def main(argv=None) -> int:
         seq_len=args.seq_len,
         lr=args.lr,
         seed=args.seed,
+        eval_every=args.eval_every,
         ckpt_dir=args.ckpt_dir,
         mesh=MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp,
                         pp=args.pp, ep=args.ep),
@@ -140,7 +163,9 @@ def main(argv=None) -> int:
         cfg = dataclasses.replace(
             cfg, model=dataclasses.replace(cfg.model, max_seq_len=cfg.seq_len + 1)
         )
-    _, last = train(cfg, data=args.data, log_path=args.log_path)
+    _, last = train(
+        cfg, data=args.data, eval_data=args.eval_data, log_path=args.log_path
+    )
     print({k: round(v, 5) for k, v in last.items()})
     return 0
 

@@ -193,10 +193,14 @@ class DataLoader:
 
 
 def make_dataset(spec: str, seq_len: int, vocab_size: Optional[int] = None):
-    """'synthetic' or a token-bin path."""
+    """'synthetic' or a token-bin path. Token-bin paths ride the C++ loader
+    (runtime/loader.cc) when the .so is present — batch-for-batch identical
+    to the Python fallback (contract: tests/test_runtime.py)."""
     if spec == "synthetic":
         return SyntheticDataset(vocab_size or 256, seq_len)
-    return TokenBinDataset(spec, seq_len)
+    from orion_tpu.runtime import make_fastest_dataset
+
+    return make_fastest_dataset(spec, seq_len)
 
 
 __all__ = [

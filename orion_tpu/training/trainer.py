@@ -478,7 +478,12 @@ class Trainer:
         metrics = {
             "loss": loss,
             "grad_norm": gnorm,
-            "lr": self.sched(state.step),
+            # the lr actually applied this step: both optimizer paths index
+            # the schedule by the GOOD-step count (non-finite steps roll the
+            # opt state — and with it the inner schedule count — back), and
+            # that count is exactly step - nonfinite, so sched(state.step)
+            # would permanently lead the applied lr after any skipped step
+            "lr": self.sched(state.step - state.nonfinite),
             "nonfinite": bad,
             "nonfinite_total": new_state.nonfinite,
         }
@@ -503,7 +508,49 @@ class Trainer:
             "Trainer was built with materialize=False (AOT planning only); "
             "no state to train"
         )
-        self.state, metrics = self._step_fn(self.state, batch)
+        try:
+            self.state, metrics = self._step_fn(self.state, batch)
+        except Exception as e:
+            # remat_skip defaults (configs.py LM_1B3/HYBRID_1B3) are tuned
+            # to exactly fit ONE 16GB v5e at the benched batch x T; any
+            # other topology/batch/accelerator inheriting them may fail to
+            # compile where skip=0 fits. Retry once fully rematted instead
+            # of dying (ADVICE r3 #1). Math is identical — only the
+            # recompute/memory trade changes.
+            msg = str(e)
+            oom = "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+            if not (oom and self.cfg.model.remat_skip and self.model.cfg.remat_skip):
+                raise
+            # only compile-time OOM is recoverable: an execution-time OOM
+            # fires after donation already invalidated the state buffers
+            if any(
+                getattr(x, "is_deleted", lambda: False)()
+                for x in jax.tree.leaves(self.state)
+            ):
+                raise
+            import warnings
+
+            warnings.warn(
+                f"train step OOM'd at remat_skip={self.model.cfg.remat_skip} "
+                f"({msg.splitlines()[0][:120]}); retrying fully rematted "
+                "(remat_skip=0)",
+                stacklevel=2,
+            )
+            self.model = TransformerLM(
+                dataclasses.replace(self.cfg.model, remat_skip=0),
+                mesh=self.mesh,
+            )
+            self._step_fn = jax.jit(
+                self._train_step,
+                donate_argnums=(0,),
+                in_shardings=(self.state_shardings, self.batch_shd),
+                out_shardings=(self.state_shardings, None),
+            )
+            self._eval_fn = jax.jit(
+                self._eval_step,
+                in_shardings=(self.state_shardings.params, self.batch_shd),
+            )
+            self.state, metrics = self._step_fn(self.state, batch)
         return metrics
 
     def train(

@@ -168,3 +168,43 @@ def test_lm_loss_fused_moe_aux_preserved():
     lf = lm_loss(model, params, batch, fused_ce=True)
     lu = lm_loss(model, params, batch, fused_ce=False)
     np.testing.assert_allclose(lf, lu, rtol=1e-5)
+
+
+def test_chunk_plan_pads_indivisible_T():
+    from orion_tpu.ops.fused_ce import chunk_plan
+
+    # divisible T: no padding, same answer as pick_n_chunks
+    assert chunk_plan(16, 2048) == (16, 2048)
+    # prime T over the row cap (r3 VERDICT weak #7): must still chunk
+    n, tp = chunk_plan(8, 1021)
+    assert n > 1 and tp >= 1021 and tp % n == 0
+    # tiny inputs stay un-chunked, un-padded
+    assert chunk_plan(1, 64) == (1, 64)
+
+
+def test_model_token_losses_padded_path_parity(monkeypatch):
+    # force the pad-and-chunk path on a tiny model: prime T=31 with a row
+    # target small enough that chunk_plan wants >1 chunk
+    import orion_tpu.ops.fused_ce as fce
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.training.trainer import lm_loss
+
+    monkeypatch.setattr(fce, "_TARGET_ROWS", 16)
+    cfg = get_config("tiny")
+    model = TransformerLM(cfg)
+    batch = jax.random.randint(
+        jax.random.PRNGKey(7), (2, 32), 0, cfg.vocab_size
+    )  # x/y are [2, 31]: T prime, 62 rows >> 16 target
+    params = model.init(jax.random.PRNGKey(8), batch[:, :-1])
+    n, tp = fce.chunk_plan(2, 31)
+    assert n > 1 and tp > 31  # the padded path is actually exercised
+    lf, gf = jax.value_and_grad(
+        lambda p: lm_loss(model, p, batch, fused_ce=True)
+    )(params)
+    lu, gu = jax.value_and_grad(
+        lambda p: lm_loss(model, p, batch, fused_ce=False)
+    )(params)
+    np.testing.assert_allclose(lf, lu, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gu)):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
