@@ -1,0 +1,154 @@
+"""Endurance orchestrator (r3 VERDICT #1): one sustained flagship pretraining
+run that exercises the WHOLE system at duration — C++ loader wraparound over
+the real BPE corpus (~3.7M tokens cycled ~34x), fused CE + remat_skip=6 +
+adafactor at the shipped b12 operating point, async orbax saves under load,
+periodic held-out eval, the nonfinite counter — plus a DELIBERATE mid-run
+SIGKILL followed by a crash-resume, the failure mode checkpointing exists for.
+
+Phases:
+  1. launch `python -m orion_tpu.train` (lm_1b3, 5200 steps) as a subprocess
+  2. watch metrics.jsonl; once step >= KILL_AT (a step safely past the 2500
+     checkpoint), SIGKILL the process group — no warning, no flush
+  3. relaunch the identical command; train.py resumes from the latest
+     complete checkpoint (orbax ignores the torn async save, data stream is
+     a pure function of (seed, step))
+  4. write ENDURANCE.json: loss/eval trajectory summary, tok/s stability
+     (first vs last quartile), kill/resume evidence, wall clock
+
+Run on the real chip: `python exp_endurance.py` (hours).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+RUN_DIR = os.path.join(REPO, "runs", "endurance")
+METRICS = os.path.join(RUN_DIR, "metrics.jsonl")
+LOG = os.path.join(RUN_DIR, "train.log")
+STEPS = 5200
+KILL_AT = 2620  # checkpoint lands at 2500; kill well into the next stretch
+
+CMD = [
+    sys.executable, "-m", "orion_tpu.train",
+    "--config", "lm_1b3",
+    "--data", os.path.join(REPO, "data", "train.bin"),
+    "--eval-data", os.path.join(REPO, "data", "val.bin"),
+    "--eval-every", "250",
+    "--steps", str(STEPS),
+    "--batch-size", "12",
+    "--seq-len", "2048",
+    "--lr", "2e-4",
+    "--ckpt-dir", os.path.join(RUN_DIR, "ckpt"),
+    "--log-path", METRICS,
+    "--set", "model.remat_skip=6",
+    "--set", "optimizer=adafactor",
+    "--set", "warmup_steps=200",
+    "--set", "ckpt_every=500",
+    "--set", "log_every=20",
+]
+
+
+def read_metrics():
+    rows = []
+    if os.path.exists(METRICS):
+        with open(METRICS) as f:
+            for line in f:
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # torn tail line from the SIGKILL
+    return rows
+
+
+def last_step(rows):
+    return max((r["step"] for r in rows), default=0)
+
+
+def launch(log_f):
+    # own process group so the SIGKILL takes the prefetch thread's process
+    # tree with it, exactly like an OOM-killer or preemption would
+    return subprocess.Popen(
+        CMD, cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+
+
+def main() -> int:
+    os.makedirs(RUN_DIR, exist_ok=True)
+    t0 = time.time()
+    evidence = {"cmd": " ".join(CMD), "steps": STEPS, "kill_at": KILL_AT}
+
+    with open(LOG, "a", buffering=1) as log_f:
+        log_f.write(f"\n=== phase 1 launch {time.ctime()} ===\n")
+        proc = launch(log_f)
+        killed_at = None
+        while proc.poll() is None:
+            time.sleep(20)
+            s = last_step(read_metrics())
+            if s >= KILL_AT:
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                killed_at = s
+                break
+        if killed_at is None:
+            # finished (or died) before the kill threshold — record and bail
+            evidence["error"] = f"phase 1 exited rc={proc.returncode} before kill"
+            evidence["last_step"] = last_step(read_metrics())
+            with open(os.path.join(REPO, "ENDURANCE.json"), "w") as f:
+                json.dump(evidence, f, indent=1)
+            return 1
+        evidence["killed_at_logged_step"] = killed_at
+        evidence["phase1_wall_s"] = round(time.time() - t0, 1)
+        log_f.write(f"\n=== SIGKILL at logged step {killed_at}; "
+                    f"relaunch {time.ctime()} ===\n")
+
+        t1 = time.time()
+        proc = launch(log_f)
+        rc = proc.wait()
+        evidence["phase2_rc"] = rc
+        evidence["phase2_wall_s"] = round(time.time() - t1, 1)
+
+    rows = read_metrics()
+    train_rows = [r for r in rows if "tokens_per_sec" in r]
+    eval_rows = [r for r in rows if "eval_ppl" in r]
+    steps_seen = [r["step"] for r in rows]
+    # resume evidence: the log contains steps both sides of the kill point,
+    # and the resumed stretch re-covers (ckpt_step, killed_at]
+    resume_overlap = sorted(
+        {s for s in steps_seen if steps_seen.count(s) > 1}
+    )
+    tps = [r["tokens_per_sec"] for r in train_rows]
+    q = max(1, len(tps) // 4)
+    evidence.update({
+        "total_wall_s": round(time.time() - t0, 1),
+        "final_step": last_step(rows),
+        "log_rows": len(rows),
+        "tokens_trained": last_step(rows) * 12 * 2048,
+        "loss_first": train_rows[0]["loss"] if train_rows else None,
+        "loss_last": train_rows[-1]["loss"] if train_rows else None,
+        "eval_ppl_trajectory": [
+            {"step": r["step"], "eval_ppl": round(r["eval_ppl"], 3)}
+            for r in eval_rows
+        ],
+        "tok_s_mean_first_quartile": round(sum(tps[:q]) / q, 1) if tps else None,
+        "tok_s_mean_last_quartile": round(sum(tps[-q:]) / q, 1) if tps else None,
+        "tok_s_min": round(min(tps), 1) if tps else None,
+        "tok_s_max": round(max(tps), 1) if tps else None,
+        "nonfinite_total": train_rows[-1].get("nonfinite_total") if train_rows else None,
+        "resumed_steps_recovered": resume_overlap[:5] + (["..."] if len(resume_overlap) > 5 else []),
+        "n_resumed_overlap_rows": len(resume_overlap),
+    })
+    with open(os.path.join(REPO, "ENDURANCE.json"), "w") as f:
+        json.dump(evidence, f, indent=1)
+    print(json.dumps(evidence, indent=1))
+    return 0 if evidence.get("phase2_rc") == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,9 +28,11 @@ cotangent.
 
 Sharding: chunks are cut along T with batch leading, so dp/fsdp batch
 sharding passes straight through the scan; tp partitions each chunk matmul
-exactly like the unfused head. Sequence-parallel (sp>1) meshes keep the
-unfused path — a T-chunked scan would slice across the token sharding
-(training/trainer.py gates this).
+exactly like the unfused head. Sequence-parallel (sp>1) meshes chunk each
+shard's LOCAL tokens inside an sp-manual shard_map (``_sp_fused_ce``) —
+per-token CE crosses no token boundary, so the body needs no sp
+collectives and the logits stay un-materialized at exactly the long-T
+operating points sp exists for.
 """
 
 from __future__ import annotations
@@ -52,18 +54,20 @@ __all__ = [
 
 def fused_ce_ok(model) -> bool:
     """Is the fused head+CE path applicable to this model? Everywhere
-    except: sp meshes (the T-chunked scan would slice across the token
-    sharding — the unfused head lowers cleanly there) and quantized models
-    (decode-only path, never trained/evaled through here)."""
-    if getattr(model, "quant", ""):
-        return False
-    if (
+    except quantized models (decode-only path, never trained/evaled through
+    here). sp meshes ride ``_sp_fused_ce``: head+CE chunked INSIDE an
+    sp-manual region over each shard's local tokens (r3 VERDICT #2 — the r3
+    gate re-materialized the logits exactly at the long-T operating points
+    sp exists for)."""
+    return not getattr(model, "quant", "")
+
+
+def _sp_active(model) -> bool:
+    return (
         model.cfg.sequence_parallel
         and model.mesh is not None
         and model.mesh.shape.get("sp", 1) > 1
-    ):
-        return False
-    return True
+    )
 
 
 def model_token_losses(model, params, x: Array, y: Array,
@@ -85,18 +89,62 @@ def model_token_losses(model, params, x: Array, y: Array,
         variables = {}
     w, w_is_vd = model.head_weight(params)
     feats = feats.astype(_dtype(model.cfg.dtype))
-    b, t = y.shape
+    if _sp_active(model):
+        losses = _sp_fused_ce(feats, w, y, model.mesh, w_is_vd)
+    else:
+        losses = _padded_fused_ce(feats, w, y, w_is_vd)
+    return losses, variables
+
+
+def _padded_fused_ce(x: Array, w: Array, labels: Array, w_is_vd: bool) -> Array:
+    """fused_linear_cross_entropy behind chunk_plan: pads T when it has no
+    divisor under the row cap (pad rows carry label 0; the slice back to
+    [B, T] transposes to a zero cotangent on them, so grads are exact — no
+    full-logits fallback path remains)."""
+    b, t = labels.shape
     n, tp = chunk_plan(b, t)
     if tp != t:
-        # no divisor of T under the cap: pad T so the scan still chunks
-        # (pad rows carry label 0; the slice below transposes to a zero
-        # cotangent on them, so grads are exact — no full-logits fallback)
-        feats = jnp.pad(feats, ((0, 0), (0, tp - t), (0, 0)))
-        y = jnp.pad(y, ((0, 0), (0, tp - t)))
-    losses = fused_linear_cross_entropy(feats, w, y, n, w_is_vd)
-    if tp != t:
-        losses = losses[:, :t]
-    return losses, variables
+        x = jnp.pad(x, ((0, 0), (0, tp - t), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, tp - t)))
+    losses = fused_linear_cross_entropy(x, w, labels, n, w_is_vd)
+    return losses[:, :t] if tp != t else losses
+
+
+def _sp_fused_ce(
+    x: Array, w: Array, labels: Array, mesh, w_is_vd: bool
+) -> Array:
+    """Fused head+CE on an sp mesh: a shard_map manual over ONLY the sp
+    axis (dp/fsdp/tp stay automatic, same partial-manual idiom as
+    parallel/pipeline.py) whose body chunks each shard's LOCAL tokens.
+    Per-token CE needs no cross-token communication, so the body has zero
+    sp collectives; the head weight enters unsharded-over-sp (P(None)) and
+    its cotangent — varying over sp — is psummed by the shard_map
+    transpose. The [B, T, V] logits now never materialize on sp meshes
+    either, which is exactly the memory that T=64k sp runs need back
+    (r3 VERDICT #2)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    sp = mesh.shape["sp"]
+    b, t = labels.shape
+    assert t % sp == 0, (t, sp)
+
+    def local(xs, wl, ys):
+        # explicitly mark w sp-varying: pvary's transpose is the psum over
+        # sp that the (sp-varying) dw cotangent needs on its way back to
+        # the unvarying P(None) input — the same idiom pipeline.py uses
+        # for its pp-replicated microbatch input
+        wl = jax.lax.pvary(wl, ("sp",))
+        return _padded_fused_ce(xs, wl, ys, w_is_vd)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, "sp", None), P(None, None), P(None, "sp")),
+        out_specs=P(None, "sp"),
+        axis_names=frozenset({"sp"}),
+    )
+    return fn(x, w, labels)
 
 # ~rows of each chunk matmul: big enough to fill the MXU (>=8 sublane tiles
 # of 8x128 per 128-row pass), small enough that the [rows, V] fp32 logits
@@ -128,7 +176,11 @@ def chunk_plan(batch: int, seq: int) -> Tuple[int, int]:
     [B, seq], which keeps gradients exact (zero cotangent on pad rows)."""
     n = pick_n_chunks(batch, seq)
     cap = max(1, (batch * seq) // _TARGET_ROWS)
-    if n == 1 and cap >= 2 and seq > 1:
+    # pad whenever the best divisor still leaves chunks far over the row
+    # target — not just n == 1: T = 2 x large-prime has divisor 2 under
+    # the cap, but half of a 16k-row sequence is still a multi-GB logits
+    # block, the exact allocation this path exists to avoid
+    if cap >= 2 and n < cap and batch * (seq // n) > 2 * _TARGET_ROWS:
         n = min(cap, seq)
         chunk = -(-seq // n)  # ceil
         return n, n * chunk
@@ -208,9 +260,15 @@ def _bwd(n_chunks, w_is_vd, res, g) -> Tuple[Array, Array, np.ndarray]:
         )
         return dw + dwc, dxc.astype(cdt)
 
-    dw, dxs = jax.lax.scan(
-        body, jnp.zeros(w.shape, jnp.float32), (xs, ys, lse, gs)
-    )
+    # the dw carry must inherit x's varying-mesh-axes type: inside the
+    # sp-manual region (_sp_fused_ce) w enters unvarying while dwc is
+    # sp-varying, and a plain-zeros carry trips the scan's carry typing —
+    # same workaround as ops/pallas/causal_dot.py::vma_zeros_state (XLA
+    # folds the zero-multiply)
+    dw0 = jnp.zeros(w.shape, jnp.float32) + 0.0 * x.astype(
+        jnp.float32
+    ).ravel()[0]
+    dw, dxs = jax.lax.scan(body, dw0, (xs, ys, lse, gs))
     b, t = labels.shape
     dx = dxs.swapaxes(0, 1).reshape(x.shape)
     # integer labels: float0 cotangent (the JAX convention for int primals)

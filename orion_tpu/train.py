@@ -37,6 +37,14 @@ def train(
 ) -> Tuple[object, dict]:
     """Build everything, optionally resume, run to cfg.steps. Returns
     (final TrainState, last metrics dict)."""
+    # config errors before the expensive part: Trainer materializes multi-GB
+    # state and the loader spawns its prefetch thread
+    if eval_data and not cfg.eval_every:
+        raise ValueError(
+            "eval_data given but eval_every == 0 — the held-out split "
+            "would silently never be evaluated; set eval_every > 0 "
+            "(CLI: --eval-every N)"
+        )
     trainer = Trainer(cfg)
     ckpt = None
     start = 0
@@ -61,12 +69,6 @@ def train(
     )
     logger = MetricsLogger(log_path)
     eval_loader = None
-    if eval_data and not cfg.eval_every:
-        raise ValueError(
-            "eval_data given but eval_every == 0 — the held-out split "
-            "would silently never be evaluated; set eval_every > 0 "
-            "(CLI: --eval-every N)"
-        )
     if cfg.eval_every:
         # a real held-out split when given (--eval-data val.bin); otherwise
         # a disjoint-seed stream over the training data

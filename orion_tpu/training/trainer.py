@@ -248,8 +248,10 @@ class Trainer:
         # remat_skip's memory budget assumes the fused-CE loss freed the
         # fp32-logits temp (configs.py LM_1B3). Paths that keep the unfused
         # head — pp (pp_lm_loss builds its own stacked pipeline; remat_skip
-        # is meaningless there anyway) and sp (_fused_ce_ok) — get the skip
-        # zeroed so they never pay un-rematted activations AND full logits.
+        # is meaningless there anyway) and quantized models (_fused_ce_ok)
+        # — get the skip zeroed so they never pay un-rematted activations
+        # AND full logits. sp meshes now ride the fused path
+        # (ops/fused_ce.py::_sp_fused_ce) and keep their skip.
         if cfg.model.remat_skip and (
             self.mesh.shape.get("pp", 1) > 1 or not _fused_ce_ok(self.model)
         ):

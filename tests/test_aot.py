@@ -181,3 +181,32 @@ def test_scaled_hybrid_compiles_with_collectives():
     cc = rep["collectives"]
     assert cc["all-gather"] > 0, cc  # fsdp param gathers
     assert cc["all-reduce"] > 0, cc  # tp psums / grad reductions
+
+
+@pytest.mark.slow
+def test_topology_aot_sp_fused_ce():
+    """Fused CE inside the sp-manual region (ops/fused_ce.py::_sp_fused_ce)
+    compiles through the real TPU compiler on an sp mesh with Mosaic
+    kernels intact, and the Trainer keeps remat_skip under sp (r3 VERDICT
+    #2). The committed SP64K_AOT.json is the same path at lm_1b3 scale:
+    T=65,536 dp1xsp8, fitting (state 5.66GB + temp 4.39GB < 16GB/device,
+    92 Mosaic kernels)."""
+    mc = MeshConfig(dp=1, sp=8)
+    mesh = _topo_mesh_or_skip(mc)
+    model = ModelConfig(
+        name="sp_fused_ce", vocab_size=512, d_model=256, n_layers=4,
+        n_heads=4, max_seq_len=4096, dtype="bfloat16", backend="pallas",
+        remat=True, remat_skip=1, sequence_parallel=True,
+    )
+    cfg = TrainConfig(
+        model=model, batch_size=2, seq_len=4096, mesh=mc,
+        optimizer="adafactor",
+    )
+    from orion_tpu.training.trainer import Trainer
+
+    tr = Trainer(cfg, mesh=mesh, materialize=False)
+    assert tr.model.cfg.remat_skip == 1  # the sp zeroing is gone
+    rep = plan(cfg, compile_step=True, mesh=mesh)
+    assert rep["compiled"]
+    cc = rep["collectives"]
+    assert cc["mosaic_kernels"] > 0, cc
