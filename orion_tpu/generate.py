@@ -125,14 +125,15 @@ def cast_params_for_inference(model: TransformerLM, params: Any) -> Any:
     )
 
 
-def quantize_for_decode(model: TransformerLM, params: Any):
-    """(model, fp32 params) -> (int8 model, int8 params): weights stored
-    int8 with per-out-channel scales so each decode step streams a quarter
-    of the HBM bytes (orion_tpu/quant.py). Reusable across generate calls —
-    quantize once, serve many."""
+def quantize_for_decode(model: TransformerLM, params: Any, mode: str = "int8"):
+    """(model, fp32 params) -> (quantized model, params): weights stored
+    int8 — or nibble-packed int4 for the matmuls with ``mode="int4"`` —
+    with per-out-channel scales, so each decode step streams 1/4 (1/8) of
+    the fp32 HBM bytes (orion_tpu/quant.py). Reusable across generate
+    calls — quantize once, serve many."""
     from orion_tpu.quant import quantize_params_for_decode
 
-    qmodel = TransformerLM(model.cfg, mesh=model.mesh, quant="int8")
+    qmodel = TransformerLM(model.cfg, mesh=model.mesh, quant=mode)
     example = jnp.zeros((1, 8), jnp.int32)
     qparams = jax.jit(
         lambda p: quantize_params_for_decode(qmodel, p, example)
@@ -194,9 +195,17 @@ def generate(
     )
     prompt = jnp.asarray(prompt, jnp.int32)
     if quant:
-        assert quant == "int8", quant
+        assert quant in ("int8", "int4"), quant
         if not model.quant:
-            model, params = quantize_for_decode(model, params)
+            model, params = quantize_for_decode(model, params, mode=quant)
+        else:
+            # an already-quantized model cannot be re-quantized to another
+            # mode — silently serving the wrong precision would corrupt
+            # latency/quality measurements
+            assert model.quant == quant, (
+                f"model is already quantized as {model.quant!r}; "
+                f"requested quant={quant!r}"
+            )
     if cast_params and not (quant or model.quant):
         # quantized trees are already minimal, and blanket-casting would
         # round the fp32 *_s scale vectors to bf16, breaking the exact
@@ -274,9 +283,9 @@ def main(argv=None) -> int:
     )
     p.add_argument("--eos", action="store_true",
                    help="stop sequences at the tokenizer's <eos>")
-    p.add_argument("--quant", default="", choices=["", "int8"],
-                   help="int8 weight-streamed decode (quarter the weight "
-                        "HBM traffic; orion_tpu/quant.py)")
+    p.add_argument("--quant", default="", choices=["", "int8", "int4"],
+                   help="weight-streamed decode: int8 quarters the weight "
+                        "HBM traffic, int4 halves it again (orion_tpu/quant.py)")
     # same mesh flags as train.py / aot.py; any axis > 1 builds a mesh
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--fsdp", type=int, default=1)

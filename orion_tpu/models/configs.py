@@ -78,6 +78,14 @@ class ModelConfig:
     # (no capacity, no train/serve asymmetry). Single-host meshes only
     # (dp/fsdp/tp); capacity dispatch remains the ep-scalable path.
     moe_dropless: bool = False
+    # dropless on ep meshes (models/moe.py::_dropless_ep): static per-shard
+    # row budget = moe_ep_buffer * (routed rows) / ep. XLA's static shapes
+    # make {truly dropless, ep-sharded, compute proportional to routed
+    # rows} a pick-two: >= ep is mathematically dropless (every shard can
+    # absorb every row) at replicated-compute cost; smaller values keep
+    # compute ~balanced and drop only under extreme router imbalance —
+    # counted in the "moe_stats" collection, never silent.
+    moe_ep_buffer: float = 2.0
     moe_group_size: int = 512  # GShard local-group length (0 = whole row)
     moe_aux_weight: float = 1e-2  # load-balance loss weight
     moe_zloss_weight: float = 1e-3  # router z-loss weight

@@ -51,6 +51,20 @@ def _dtype(name: str):
     return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
 
 
+def _qdense_factory(quant: str, dt):
+    """Dense-layer factory for the weight-streamed decode modes, or None
+    for full-precision. "int8": every matmul int8. "int4": matmul weights
+    nibble-packed int4, while embedding/head (token-distribution-critical,
+    table shared) and MoE expert stacks stay int8 — the mixed scheme
+    VERDICT r3 #5 names."""
+    if not quant:
+        return None
+    from orion_tpu.quant import Int4Dense, Int8Dense
+
+    cls = {"int8": Int8Dense, "int4": Int4Dense}[quant]
+    return lambda n, feats: cls(feats, dtype=dt, name=n)
+
+
 def _norm(cfg: ModelConfig, name: str):
     if cfg.norm == "rmsnorm":
         return nn.RMSNorm(dtype=_dtype(cfg.dtype), name=name)
@@ -88,12 +102,7 @@ class Attention(nn.Module):
         dense = lambda n, feats: nn.Dense(  # noqa: E731
             feats, use_bias=False, dtype=dt, param_dtype=pdt, name=n
         )
-        if self.quant == "int8":
-            from orion_tpu.quant import Int8Dense
-
-            qdense = lambda n, feats: Int8Dense(feats, dtype=dt, name=n)  # noqa: E731
-        else:
-            qdense = dense
+        qdense = _qdense_factory(self.quant, dt) or dense
         self.wq = qdense("wq", h * dh)
         self.wk = qdense("wk", h * dh)
         self.wv = qdense("wv", h * dh)
@@ -404,14 +413,11 @@ class MLP(nn.Module):
         cfg = self.cfg
         dt, pdt = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
         h = cfg.resolved_mlp_hidden
-        if self.quant == "int8":
-            from orion_tpu.quant import Int8Dense
-
-            dense = lambda n, feats: Int8Dense(feats, dtype=dt, name=n)  # noqa: E731
-        else:
-            dense = lambda n, feats: nn.Dense(  # noqa: E731
+        dense = _qdense_factory(self.quant, dt) or (
+            lambda n, feats: nn.Dense(
                 feats, use_bias=False, dtype=dt, param_dtype=pdt, name=n
             )
+        )
         if cfg.mlp == "swiglu":
             gate = dense("gate", h)(x)
             up = dense("up", h)(x)
@@ -483,7 +489,7 @@ class TransformerLM(nn.Module):
     def setup(self):
         cfg = self.cfg
         pdt = _dtype(cfg.param_dtype)
-        if self.quant == "int8":
+        if self.quant:  # int8 table in both quant modes (head fidelity)
             from orion_tpu.quant import Int8Embed
 
             self.embed = Int8Embed(cfg.vocab_size, cfg.d_model)
@@ -506,7 +512,7 @@ class TransformerLM(nn.Module):
         ]
         self.final_norm = _norm(cfg, "final_norm")
         if not cfg.tie_embeddings:
-            if self.quant == "int8":
+            if self.quant:
                 self.lm_head_kernel_q = self.param(
                     "lm_head_kernel_q",
                     nn.initializers.zeros_init(),
@@ -577,7 +583,7 @@ class TransformerLM(nn.Module):
         with fp32 MXU accumulation — a pure-fp32 [.., D]x[D, V] head matmul
         is ~4x slower on TPU for no useful precision gain."""
         cdt = _dtype(self.cfg.dtype)
-        if self.quant == "int8":
+        if self.quant:
             if self.cfg.tie_embeddings:
                 return self.embed.attend(x, cdt)
             y = jnp.einsum(

@@ -37,8 +37,8 @@ _RULES = (
     # logits' layout free for GSPMD (fsdp-sharding it forced an involuntary
     # full rematerialization of the logits under fsdp x ep meshes)
     (r"router/kernel$", P(None, None)),
-    (r"(wq|wk|wv|gate|up|phi_proj)/kernel(_q)?$", P("fsdp", "tp")),
-    (r"(wo|down)/kernel(_q)?$", P("tp", "fsdp")),
+    (r"(wq|wk|wv|gate|up|phi_proj)/kernel(_q|_p4)?$", P("fsdp", "tp")),
+    (r"(wo|down)/kernel(_q|_p4)?$", P("tp", "fsdp")),
     (r"lm_head_kernel(_q)?$", P("fsdp", "tp")),
     (r"head/kernel$", P("fsdp", None)),
     # the int8 token table is replicated (4x smaller than fp32): gather on

@@ -48,16 +48,74 @@ def quantize_int8(w: Array, reduce_axes) -> tuple[Array, Array]:
     return q, jnp.squeeze(s, axis=reduce_axes)
 
 
+def quantize_int4_packed(w: Array, reduce_axes=(0,)) -> tuple[Array, Array]:
+    """Symmetric per-out-channel int4 with two nibbles PACKED per int8 byte
+    along axis 0: w [in, out] -> (p int8 [in/2, out], s fp32 [out]).
+
+    Packed storage (not jnp.int4) so the HBM stream provably halves on any
+    backend — XLA may hold int4 arrays byte-per-element. The unpack
+    (_unpack_nibbles: two arithmetic shifts + interleave) is elementwise on
+    the weight read, which XLA fuses into the dot exactly like the int8
+    convert (module docstring)."""
+    assert reduce_axes == (0,), "packed int4 is defined for [in, out] kernels"
+    assert w.shape[0] % 2 == 0, w.shape
+    w = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=0, keepdims=True)
+    s = jnp.maximum(amax, 1e-12) / 7.0
+    q = jnp.clip(jnp.round(w / s), -7, 7).astype(jnp.int8)
+    qe, qo = q[0::2], q[1::2]  # even/odd input rows share a byte
+    p = ((qe & 0x0F) | (qo << 4)).astype(jnp.int8)
+    return p, jnp.squeeze(s, axis=0)
+
+
+def _unpack_nibbles(p: Array, d_in: int) -> Array:
+    """[in/2, out] packed int8 -> [in, out] int8 in [-7, 7] (arithmetic
+    shifts sign-extend both nibbles)."""
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+    hi = jnp.right_shift(p, 4)
+    return jnp.stack([lo, hi], axis=1).reshape(d_in, p.shape[-1])
+
+
 # reduce axes (the input/contraction dims) by quantized-leaf basename; the
 # surviving axes are the dot's output channels, whose scale commutes out
 _REDUCE_AXES = {
     "kernel_q": (0,),  # [in, out] -> s[out]
+    "kernel_p4": (0,),  # packed int4 [in/2, out] -> s[out]
     "embedding_q": (1,),  # [V, D]: head out-channel is V -> s[V]
     "lm_head_kernel_q": (0,),  # [D, V] -> s[V]
     "experts_gate_q": (1,),  # [E, in, out] -> s[E, out]
     "experts_up_q": (1,),
     "experts_down_q": (1,),
 }
+
+
+class Int4Dense(nn.Module):
+    """Drop-in for ``nn.Dense(use_bias=False)`` at int4: nibble-packed
+    kernel + per-out-channel fp32 scale (VERDICT r3 #5 — b1 decode is
+    weight-HBM-bound even at int8, so halving the stream again is the next
+    latency lever). Embedding/head/experts stay int8 in the "int4" serving
+    mode (transformer.py): the head's logit precision sets greedy-token
+    fidelity, and its table is shared with the embedding."""
+
+    features: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        d_in = x.shape[-1]
+        assert d_in % 2 == 0, d_in
+        p = self.param(
+            "kernel_p4",
+            nn.initializers.zeros_init(),
+            (d_in // 2, self.features),
+            jnp.int8,
+        )
+        s = self.param(
+            "kernel_s", nn.initializers.ones_init(), (self.features,), jnp.float32
+        )
+        w = _unpack_nibbles(p, d_in)
+        y = jnp.dot(x.astype(self.dtype), w.astype(self.dtype))
+        return (y.astype(jnp.float32) * s).astype(self.dtype)
 
 
 class Int8Dense(nn.Module):
@@ -137,7 +195,13 @@ def quantize_params_for_decode(quant_model, params: Any, example_tokens) -> Any:
         key = jax.tree_util.keystr(path)
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
         if name.endswith("_s"):
-            return None  # produced together with its _q twin
+            return None  # produced together with its _q/_p4 twin
+        if name.endswith("_p4"):
+            src_key = key[: -len("_p4']")] + "']"
+            q, s = quantize_int4_packed(src[src_key], _REDUCE_AXES[name])
+            assert q.shape == leaf.shape and q.dtype == leaf.dtype, (
+                key, q.shape, leaf.shape)
+            return q, s
         if name.endswith("_q"):
             src_key = key[: -len("_q']")] + "']"
             w = src[src_key]
@@ -159,7 +223,8 @@ def quantize_params_for_decode(quant_model, params: Any, example_tokens) -> Any:
         val = build(path, leaf)
         out[key] = (path, val[0])
         if val[1] is not None:
-            skey = key[: -len("_q']")] + "_s']"
+            suffix = "_p4']" if name.endswith("_p4") else "_q']"
+            skey = key[: -len(suffix)] + "_s']"
             out[skey] = (None, val[1])
     # attach scale paths, verify every expected leaf is present
     result_flat = []
@@ -180,7 +245,9 @@ def quantize_params_for_decode(quant_model, params: Any, example_tokens) -> Any:
 
 __all__ = [
     "Int8Dense",
+    "Int4Dense",
     "Int8Embed",
     "quantize_int8",
+    "quantize_int4_packed",
     "quantize_params_for_decode",
 ]

@@ -236,7 +236,9 @@ class TestMoEMLP:
         gr = np.asarray(g["params"]["router"]["kernel"])
         assert np.abs(gr).max() > 0
 
-    def test_dropless_rejects_ep_mesh(self):
+    def test_dropless_quant_rejects_ep_mesh(self):
+        # int8 dropless serving stays single-host; the TRAIN path shards
+        # over ep (test_dropless_ep_* below)
         from jax.sharding import Mesh
 
         cfg = ModelConfig(
@@ -244,9 +246,112 @@ class TestMoEMLP:
             dtype="float32", moe_dropless=True,
         )
         mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("ep",))
-        m = MoEMLP(cfg, mesh=mesh)
-        with pytest.raises(AssertionError, match="dropless"):
+        m = MoEMLP(cfg, mesh=mesh, quant="int8")
+        with pytest.raises(AssertionError, match="single-host"):
             m.init(jax.random.PRNGKey(0), jnp.zeros((2, 16, 16)))
+
+    @pytest.mark.parametrize("ep,k", [(2, 1), (2, 2), (4, 2)])
+    def test_dropless_ep_matches_single_host(self, ep, k):
+        """_dropless_ep (rotated-sort prefix + zero-expert ragged_dot +
+        psum) == the single-host dropless path, with buffer >= ep (the
+        mathematically-dropless setting)."""
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=4, moe_top_k=k,
+            dtype="float32", moe_dropless=True, moe_ep_buffer=float(ep),
+        )
+        mesh = make_mesh(MeshConfig(dp=1, ep=ep))
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 16, 16))
+        m_ref = MoEMLP(cfg)
+        p = m_ref.init(jax.random.PRNGKey(1), x)
+        m_ep = MoEMLP(cfg, mesh=mesh)
+        # identical param trees: checkpoints move across mesh shapes
+        jax.tree.map(
+            lambda a, b: None, p, m_ep.init(jax.random.PRNGKey(2), x)
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_ep.apply(p, x)),
+            np.asarray(m_ref.apply(p, x)),
+            atol=2e-5, rtol=2e-5,
+        )
+
+    def test_dropless_ep_grads_match_single_host(self):
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=4, moe_top_k=2,
+            dtype="float32", moe_dropless=True, moe_ep_buffer=2.0,
+        )
+        mesh = make_mesh(MeshConfig(dp=1, ep=2))
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 16, 16))
+        m_ref, m_ep = MoEMLP(cfg), MoEMLP(cfg, mesh=mesh)
+        p = m_ref.init(jax.random.PRNGKey(1), x)
+
+        def loss(m):
+            def f(p):
+                y, aux = m.apply(p, x, mutable=["losses", "moe_stats"])
+                return (y**2).mean() + sum(jax.tree.leaves(aux["losses"]))
+            return f
+
+        gr = jax.grad(loss(m_ref))(p)
+        ge = jax.grad(loss(m_ep))(p)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5
+            ),
+            gr, ge,
+        )
+
+    def test_dropless_ep_overflow_counted_not_silent(self):
+        """A starved budget (moe_ep_buffer far below ep) must COUNT its
+        drops in the moe_stats collection and still produce finite
+        outputs — never silently diverge."""
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        cfg = ModelConfig(
+            name="t", d_model=16, n_experts=4, moe_top_k=1,
+            dtype="float32", moe_dropless=True, moe_ep_buffer=0.05,
+        )
+        mesh = make_mesh(MeshConfig(dp=1, ep=2))
+        m = MoEMLP(cfg, mesh=mesh)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 16))
+        p = m.init(jax.random.PRNGKey(1), x)
+        y, aux = m.apply(p, x, mutable=["losses", "moe_stats"])
+        assert np.isfinite(np.asarray(y)).all()
+        (dropped,) = jax.tree.leaves(aux["moe_stats"])
+        assert int(dropped) > 0  # the starved budget really dropped rows
+
+    def test_dropless_ep_trainer_step_parity(self):
+        """Full train step on a dp2 x ep2 mesh with dropless MoE == the
+        single-device dropless step (loss and updated params)."""
+        from orion_tpu.parallel.mesh import MeshConfig
+        from orion_tpu.training.data import SyntheticDataset
+        from orion_tpu.training.trainer import TrainConfig, Trainer
+
+        model = ModelConfig(
+            name="t", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+            max_seq_len=64, dtype="float32", n_experts=4, moe_period=2,
+            moe_top_k=2, moe_dropless=True, moe_ep_buffer=2.0,
+        )
+        mk = lambda mesh: TrainConfig(  # noqa: E731
+            model=model, steps=1, batch_size=4, seq_len=16, lr=1e-3,
+            warmup_steps=1, mesh=mesh, log_every=1,
+        )
+        batch = jnp.asarray(SyntheticDataset(64, 16).batch(0, 0, 4))
+        t_ref = Trainer(mk(MeshConfig(dp=1)))
+        t_ep = Trainer(mk(MeshConfig(dp=2, ep=2)))
+        m_ref = t_ref.step(batch)
+        m_ep = t_ep.step(batch)
+        np.testing.assert_allclose(
+            float(m_ep["loss"]), float(m_ref["loss"]), atol=2e-5, rtol=2e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5
+            ),
+            t_ep.state.params, t_ref.state.params,
+        )
 
     def test_dropless_decode_matches_parallel_argmax(self):
         """The asymmetry dropless kills: parallel forward == recurrent
