@@ -80,6 +80,10 @@ def main(argv=None) -> int:
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument("--n-batches", type=int, default=16)
+    p.add_argument("--quant", default="", choices=["", "int8", "int4"],
+                   help="evaluate through the weight-streamed decode model "
+                        "(the quant acceptance bar: eval-ppl delta vs fp32 "
+                        "on the same held-out data)")
     args = p.parse_args(argv)
 
     from orion_tpu.generate import load_params
@@ -90,11 +94,15 @@ def main(argv=None) -> int:
     # train.py auto-bumps max_seq_len when seq_len >= max_seq_len, so read
     # the real positional capacity off the stored pos_embed table
     try:
+        import dataclasses
+
         pos_rows = params["params"]["pos_embed"]["embedding"].shape[0]
         if pos_rows != cfg.max_seq_len:
-            import dataclasses
-
             cfg = dataclasses.replace(cfg, max_seq_len=pos_rows)
+        # same for the vocab (train --set model.vocab_size=... runs)
+        vocab = params["params"]["embed"]["embedding"].shape[0]
+        if vocab != cfg.vocab_size:
+            cfg = dataclasses.replace(cfg, vocab_size=vocab)
     except (KeyError, TypeError):
         pass
     assert args.seq_len < cfg.max_seq_len, (
@@ -107,9 +115,15 @@ def main(argv=None) -> int:
         from orion_tpu.parallel.pipeline_lm import unstack_lm_params
 
         params = unstack_lm_params(model, params)
+    if args.quant:
+        from orion_tpu.generate import quantize_for_decode
+
+        model, params = quantize_for_decode(model, params, mode=args.quant)
     dataset = make_dataset(args.data, args.seq_len, cfg.vocab_size)
     res = evaluate_lm(model, params, dataset, args.batch_size, args.n_batches)
     res["step"] = step
+    if args.quant:
+        res["quant"] = args.quant
     print(res)
     return 0
 

@@ -250,9 +250,14 @@ def generate_unconditional(
 
 def load_params(ckpt_dir: str, step: Optional[int] = None) -> Tuple[Any, int]:
     """Pull just the params subtree out of a training checkpoint."""
+    import os
+
     import orbax.checkpoint as ocp
 
-    mngr = ocp.CheckpointManager(ckpt_dir)
+    # orbax requires absolute paths; the Trainer-side Checkpointer already
+    # abspaths, this CLI-side loader must too ("--ckpt-dir ck" otherwise
+    # dies deep in tensorstore)
+    mngr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
     step = mngr.latest_step() if step is None else step
     if step is None:
         raise FileNotFoundError(f"no checkpoint in {ckpt_dir}")

@@ -318,6 +318,25 @@ class MoEMLP(nn.Module):
         self._sow_flat_aux(logits, probs, ids)
 
         flat = ids.reshape(-1)  # [N*k], token-major
+        from orion_tpu.ops.dispatch import resolve
+
+        b = resolve(cfg.backend)
+        # grouped-matmul Mosaic kernel (ops/pallas/gmm.py): tile-aligned
+        # expert segments instead of ragged groups. Worth it at training
+        # row counts; decode calls (tiny m) and the quant path (per-row
+        # scale tables) keep ragged_dot. Single-device meshes only: GSPMD
+        # cannot auto-partition a Mosaic call (parallel/kernel_shard.py),
+        # and the dropless GSPMD path's ops are all token-local so the
+        # ragged form shards cleanly there; ep meshes ride _dropless_ep.
+        if (
+            b.startswith("pallas")
+            and flat.shape[0] >= 1024
+            and not self.quant
+            and (self.mesh is None or self.mesh.devices.size == 1)
+        ):
+            return self._dropless_gmm(
+                x, x2, flat, gates, interpret=(b == "pallas_interpret")
+            )
         order, inv, counts = _counting_sort_perm(flat, e)
         xs = jnp.take(x2.astype(dt), order // k, axis=0)  # [N*k, d]
         sorted_ids = jnp.take(flat, order, axis=0)  # for quant scale rows
@@ -357,6 +376,51 @@ class MoEMLP(nn.Module):
             ys = rd(mid, wdn)
 
         y = jnp.take(ys, inv, axis=0).reshape(n, k, d)
+        y = jnp.sum(y * gates[..., None].astype(dt), axis=1)
+        return y.reshape(x.shape).astype(dt)
+
+    def _dropless_gmm(
+        self, x: Array, x2: Array, flat: Array, gates: Array, interpret: bool
+    ) -> Array:
+        """Dropless expert FFNs through the grouped-matmul kernel
+        (ops/pallas/gmm.py). Rows are scattered into TILE-ALIGNED expert
+        segments (pad rows are zeros — they flow through the FFN as zeros
+        and contribute nothing to dw), so the kernel runs dense MXU tiles
+        with a scalar-prefetched tile->expert table. <= E*(tile-1) wasted
+        rows, ~2% at flagship shapes."""
+        from orion_tpu.ops.pallas.gmm import gmm, pad_group_sizes
+
+        cfg = self.cfg
+        dt, pdt = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        e, k, h = cfg.n_experts, cfg.moe_top_k, cfg.resolved_mlp_hidden
+        d = x2.shape[-1]
+        m = flat.shape[0]
+        tm = 128
+        _, rank, counts = _counting_sort_perm(flat, e)
+        offs_tight = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]]
+        )
+        seg, starts = pad_group_sizes(counts, tm)
+        pos = starts[flat] + (rank - offs_tight[flat])  # padded row slot
+        m2 = -(-(m + e * tm) // tm) * tm
+        xs = jnp.zeros((m2, d), dt).at[pos].set(
+            jnp.take(x2.astype(dt), jnp.arange(m) // k, axis=0)
+        )
+
+        if cfg.mlp == "swiglu":
+            wg = self.param("experts_gate", _expert_init(), (e, d, h), pdt)
+            wu = self.param("experts_up", _expert_init(), (e, d, h), pdt)
+            mid = jax.nn.silu(gmm(xs, wg, seg, tm, 512, interpret)) * gmm(
+                xs, wu, seg, tm, 512, interpret
+            )
+        else:
+            wu = self.param("experts_up", _expert_init(), (e, d, h), pdt)
+            mid = jax.nn.gelu(gmm(xs, wu, seg, tm, 512, interpret))
+        wdn = self.param("experts_down", _expert_init(), (e, h, d), pdt)
+        ys = gmm(mid, wdn, seg, tm, 512, interpret)  # [M2, d]
+
+        n = m // k
+        y = jnp.take(ys, pos, axis=0).reshape(n, k, d)
         y = jnp.sum(y * gates[..., None].astype(dt), axis=1)
         return y.reshape(x.shape).astype(dt)
 
