@@ -68,7 +68,7 @@ def train(
         sharding=trainer.batch_shd,
     )
     logger = MetricsLogger(log_path)
-    eval_loader = None
+    eval_factory = None
     if cfg.eval_every:
         # a real held-out split when given (--eval-data val.bin); otherwise
         # a disjoint-seed stream over the training data
@@ -81,22 +81,40 @@ def train(
             f"eval data vocab {eval_ds.vocab_size} > model vocab "
             f"{cfg.model.vocab_size}"
         )
-        eval_loader = DataLoader(
-            eval_ds, cfg.batch_size, seed=cfg.seed + 1,
-            start_step=10_000_000, sharding=trainer.batch_shd,
-        )
+
+        def eval_factory(step, _ds=eval_ds):
+            # batches a pure function of the TRAIN step — a resumed run
+            # re-evaluates any step's eval on the exact same batches. A
+            # short-lived DataLoader keeps the prefetch overlap AND the
+            # multi-host make_array_from_callback path (data.py P7/P11)
+            # the sampling math alone would lose.
+            base = 10_000_000 + step * cfg.eval_batches
+            loader = DataLoader(
+                _ds, cfg.batch_size, seed=cfg.seed + 1, start_step=base,
+                sharding=trainer.batch_shd,
+            )
+
+            def gen():
+                try:
+                    it = iter(loader)
+                    for j in range(cfg.eval_batches):
+                        batch = next(it)
+                        if j == cfg.eval_batches - 1:
+                            loader.close()  # last batch out; stop the thread
+                        yield batch
+                finally:
+                    loader.close()  # safety if the consumer stops early
+
+            return gen()
     try:
         last = trainer.train(
-            iter(loader), logger=logger, ckpt=ckpt,
-            eval_iter=iter(eval_loader) if eval_loader else None,
+            iter(loader), logger=logger, ckpt=ckpt, eval_factory=eval_factory
         )
         if ckpt is not None:
             ckpt.maybe_save(int(trainer.state.step), trainer.state, force=True)
             ckpt.wait()
     finally:
         loader.close()
-        if eval_loader is not None:
-            eval_loader.close()
         logger.close()
     return trainer.state, last
 

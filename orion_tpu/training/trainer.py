@@ -556,10 +556,15 @@ class Trainer:
         return metrics
 
     def train(
-        self, data_iter, logger=None, ckpt=None, hook=None, eval_iter=None
+        self, data_iter, logger=None, ckpt=None, hook=None, eval_iter=None,
+        eval_factory=None,
     ) -> Dict[str, float]:
         """Run cfg.steps - state.step steps. Returns last metrics (host).
-        ``eval_iter`` + cfg.eval_every > 0 interleaves held-out evals."""
+        ``eval_iter`` + cfg.eval_every > 0 interleaves held-out evals.
+        ``eval_factory(step) -> iterator`` makes each eval's batches a pure
+        function of the TRAIN step (resume-deterministic — a long-lived
+        eval_iter's position depends on how many evals this process has
+        already run, so a resumed run re-samples different batches)."""
         cfg = self.cfg
         tokens_per_step = cfg.batch_size * cfg.seq_len
         last: Dict[str, float] = {}
@@ -584,11 +589,13 @@ class Trainer:
                 if logger:
                     logger.log(step, last, tokens_per_step)
             if (
-                eval_iter is not None
+                (eval_iter is not None or eval_factory is not None)
                 and cfg.eval_every
                 and (step % cfg.eval_every == 0 or step == cfg.steps)
             ):
-                ev = self.evaluate(eval_iter)
+                ev = self.evaluate(
+                    eval_factory(step) if eval_factory is not None else eval_iter
+                )
                 last.update(ev)
                 if logger:
                     logger.log(step, ev)
