@@ -25,15 +25,23 @@ def main():
     k = jax.random.normal(jax.random.fold_in(key, 1), q.shape, jnp.bfloat16)
     v = jax.random.normal(jax.random.fold_in(key, 2), q.shape, jnp.bfloat16)
 
+    import numpy as np
+
     def run(fn, *args):
-        out = fn(*args)
-        jax.tree.leaves(out)[0].block_until_ready()
-        # relay: time many dispatches against one readback
+        # block_until_ready is NOT a barrier through the axon relay and a
+        # full-tensor readback is ~100MB over a slow tunnel — reduce to a
+        # SCALAR inside jit so the readback is 4 bytes
+        f = jax.jit(
+            lambda *a: sum(
+                t.astype(jnp.float32).sum() for t in jax.tree.leaves(fn(*a))
+            )
+        )
+        np.asarray(f(*args))  # compile + warm
         iters = 10
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = fn(*args)
-        jax.tree.leaves(out)[0].block_until_ready()
+            out = f(*args)
+        np.asarray(out)
         return (time.perf_counter() - t0) / iters * 1000
 
     for bq, bk in [(512, 512), (256, 512), (512, 256), (256, 256),

@@ -395,7 +395,10 @@ class MoEMLP(nn.Module):
         e, k, h = cfg.n_experts, cfg.moe_top_k, cfg.resolved_mlp_hidden
         d = x2.shape[-1]
         m = flat.shape[0]
-        tm = 128
+        # (128, 512) is the VMEM-feasible optimum at flagship shapes: the
+        # r4 on-chip sweep measured tm=256 and bh=1024 variants OOMing the
+        # 16MB VMEM stack on the wide-d (5504) matmuls' blocks
+        tm, bh = 128, 512
         _, rank, counts = _counting_sort_perm(flat, e)
         offs_tight = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]]
@@ -410,14 +413,14 @@ class MoEMLP(nn.Module):
         if cfg.mlp == "swiglu":
             wg = self.param("experts_gate", _expert_init(), (e, d, h), pdt)
             wu = self.param("experts_up", _expert_init(), (e, d, h), pdt)
-            mid = jax.nn.silu(gmm(xs, wg, seg, tm, 512, interpret)) * gmm(
-                xs, wu, seg, tm, 512, interpret
+            mid = jax.nn.silu(gmm(xs, wg, seg, tm, bh, interpret)) * gmm(
+                xs, wu, seg, tm, bh, interpret
             )
         else:
             wu = self.param("experts_up", _expert_init(), (e, d, h), pdt)
-            mid = jax.nn.gelu(gmm(xs, wu, seg, tm, 512, interpret))
+            mid = jax.nn.gelu(gmm(xs, wu, seg, tm, bh, interpret))
         wdn = self.param("experts_down", _expert_init(), (e, h, d), pdt)
-        ys = gmm(mid, wdn, seg, tm, 512, interpret)  # [M2, d]
+        ys = gmm(mid, wdn, seg, tm, bh, interpret)  # [M2, d]
 
         n = m // k
         y = jnp.take(ys, pos, axis=0).reshape(n, k, d)
