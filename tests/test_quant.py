@@ -244,3 +244,20 @@ def test_int4_sampled_decode_runs():
     )
     assert np.asarray(out).shape == (2, 8)
     assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 64).all()
+
+
+def test_q4_matmul_kernel_matches_split_form():
+    """The Mosaic fused dequant-matmul (interpret mode) == the XLA split
+    half-dots form == manual dequant reference."""
+    from orion_tpu.quant import _unpack_nibbles, q4_matmul, quantize_int4_packed
+
+    # out=300 with block_out=128 -> a 3-block grid incl. a padded tail,
+    # exercising the j>0 index maps and the out-dim pad/slice
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 300)) * 0.2
+    p, s = quantize_int4_packed(w)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 64))
+    got = np.asarray(q4_matmul(x, p, s, block_out=128, interpret=True))
+    want = np.asarray(
+        x @ (_unpack_nibbles(p, 64).astype(jnp.float32) * s)
+    )
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
