@@ -261,3 +261,15 @@ def test_q4_matmul_kernel_matches_split_form():
         x @ (_unpack_nibbles(p, 64).astype(jnp.float32) * s)
     )
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_generate_rejects_quant_mode_mismatch():
+    """An already-quantized model cannot be re-served at another mode —
+    silently serving the wrong precision would corrupt measurements."""
+    cfg = _hybrid_cfg()
+    model = TransformerLM(cfg)
+    toks = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    qmodel, qparams = quantize_for_decode(model, params, mode="int8")
+    with pytest.raises(AssertionError, match="already quantized"):
+        generate(qmodel, qparams, toks, 4, SampleConfig(0.0), quant="int4")
