@@ -133,6 +133,21 @@ def quantize_for_decode(model: TransformerLM, params: Any, mode: str = "int8"):
     calls — quantize once, serve many."""
     from orion_tpu.quant import quantize_params_for_decode
 
+    cfg = model.cfg
+    if (
+        cfg.n_experts
+        and cfg.moe_dropless
+        and model.mesh is not None
+        and model.mesh.shape.get("ep", 1) > 1
+    ):
+        # ADVICE r4: fail at setup, not as an AssertionError deep inside
+        # jit tracing (models/moe.py keeps the assert as a backstop)
+        raise ValueError(
+            "quantized serving of a dropless MoE is single-host only: the "
+            "per-row scale tables don't ride _dropless_ep's budgeted "
+            "ragged form. Serve on an ep=1 mesh, or use the capacity path "
+            "(moe_dropless=False) on ep meshes."
+        )
     qmodel = TransformerLM(model.cfg, mesh=model.mesh, quant=mode)
     example = jnp.zeros((1, 8), jnp.int32)
     qparams = jax.jit(

@@ -51,18 +51,23 @@ def _dtype(name: str):
     return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
 
 
-def _qdense_factory(quant: str, dt):
+def _qdense_factory(quant: str, dt, mesh=None):
     """Dense-layer factory for the weight-streamed decode modes, or None
     for full-precision. "int8": every matmul int8. "int4": matmul weights
     nibble-packed int4, while embedding/head (token-distribution-critical,
     table shared) and MoE expert stacks stay int8 — the mixed scheme
-    VERDICT r3 #5 names."""
+    VERDICT r3 #5 names. ``mesh`` reaches Int4Dense so its fused-kernel
+    gate reflects the MODEL's mesh, not the host's device count
+    (ADVICE r4: a single-device model on a multi-device host must not
+    silently lose the kernel)."""
     if not quant:
         return None
     from orion_tpu.quant import Int4Dense, Int8Dense
 
-    cls = {"int8": Int8Dense, "int4": Int4Dense}[quant]
-    return lambda n, feats: cls(feats, dtype=dt, name=n)
+    if quant == "int4":
+        return lambda n, feats: Int4Dense(feats, dtype=dt, mesh=mesh, name=n)
+    assert quant == "int8", quant
+    return lambda n, feats: Int8Dense(feats, dtype=dt, name=n)
 
 
 def _norm(cfg: ModelConfig, name: str):
@@ -102,7 +107,7 @@ class Attention(nn.Module):
         dense = lambda n, feats: nn.Dense(  # noqa: E731
             feats, use_bias=False, dtype=dt, param_dtype=pdt, name=n
         )
-        qdense = _qdense_factory(self.quant, dt) or dense
+        qdense = _qdense_factory(self.quant, dt, self.mesh) or dense
         self.wq = qdense("wq", h * dh)
         self.wk = qdense("wk", h * dh)
         self.wv = qdense("wv", h * dh)
@@ -407,13 +412,14 @@ def _swa_cache_from_prefill(kr: Array, v: Array, t: int, window: int) -> State:
 class MLP(nn.Module):
     cfg: ModelConfig
     quant: str = ""
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
         cfg = self.cfg
         dt, pdt = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
         h = cfg.resolved_mlp_hidden
-        dense = _qdense_factory(self.quant, dt) or (
+        dense = _qdense_factory(self.quant, dt, self.mesh) or (
             lambda n, feats: nn.Dense(
                 feats, use_bias=False, dtype=dt, param_dtype=pdt, name=n
             )
@@ -458,7 +464,9 @@ class Block(nn.Module):
                 self.cfg, mesh=self.mesh, quant=self.quant, name="mlp"
             )
         else:
-            self.mlp = MLP(self.cfg, quant=self.quant, name="mlp")
+            self.mlp = MLP(
+                self.cfg, quant=self.quant, mesh=self.mesh, name="mlp"
+            )
         self.drop = nn.Dropout(self.cfg.dropout)
 
     def __call__(self, x, mask=None, deterministic=True):

@@ -82,7 +82,8 @@ def model_token_losses(model, params, x: Array, y: Array,
 
     if mutable:
         feats, variables = model.apply(
-            params, x, mutable="losses", method="features", **apply_kwargs
+            params, x, mutable=["losses", "moe_stats"], method="features",
+            **apply_kwargs,
         )
     else:
         feats = model.apply(params, x, method="features", **apply_kwargs)
@@ -130,11 +131,14 @@ def _sp_fused_ce(
     assert t % sp == 0, (t, sp)
 
     def local(xs, wl, ys):
-        # explicitly mark w sp-varying: pvary's transpose is the psum over
-        # sp that the (sp-varying) dw cotangent needs on its way back to
-        # the unvarying P(None) input — the same idiom pipeline.py uses
+        # explicitly mark w sp-varying: the cast's transpose is the psum
+        # over sp that the (sp-varying) dw cotangent needs on its way back
+        # to the unvarying P(None) input — the same idiom pipeline.py uses
         # for its pp-replicated microbatch input
-        wl = jax.lax.pvary(wl, ("sp",))
+        if hasattr(jax.lax, "pcast"):
+            wl = jax.lax.pcast(wl, ("sp",), to="varying")
+        else:  # older jax spelling (deprecated in 0.9)
+            wl = jax.lax.pvary(wl, ("sp",))
         return _padded_fused_ce(xs, wl, ys, w_is_vd)
 
     fn = shard_map(

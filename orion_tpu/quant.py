@@ -29,7 +29,7 @@ recurrent-decode performance story.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -164,6 +164,7 @@ class Int4Dense(nn.Module):
 
     features: int
     dtype: Any
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -186,14 +187,16 @@ class Int4Dense(nn.Module):
         dt = self.dtype
         lead = x.shape[:-1]
         x2 = x.reshape(-1, d_in).astype(dt)
-        # single-device only (GSPMD cannot auto-partition a Mosaic call —
-        # parallel/kernel_shard.py) and decode-sized row counts only: the
-        # GEMV kernel holds the full x rows in VMEM, which prefill's
-        # B*T rows overflow (prefill is MXU-bound anyway, the split form
-        # below serves it fine)
+        # single-device MESH only (GSPMD cannot auto-partition a Mosaic
+        # call — parallel/kernel_shard.py; gate on the model's mesh, not
+        # jax.device_count(): a mesh=None model served on a multi-device
+        # HOST must keep the kernel — ADVICE r4) and decode-sized row
+        # counts only: the GEMV kernel holds the full x rows in VMEM,
+        # which prefill's B*T rows overflow (prefill is MXU-bound anyway,
+        # the split form below serves it fine)
         if (
             jax.default_backend() != "cpu"
-            and jax.device_count() == 1
+            and (self.mesh is None or self.mesh.devices.size == 1)
             and x2.shape[0] <= 64
         ):
             y = q4_matmul(x2, p, s)

@@ -932,3 +932,53 @@ class TestGmm:
             gg, gr,
         )
         assert calls, "the gmm branch was never taken — threshold changed?"
+
+
+def test_moe_overflow_metric_surfaces_in_trainer():
+    """ADVICE r4 (medium): the dropless-ep overflow counter must have a
+    consumer. Ample budget -> metric present and 0; starved budget ->
+    Trainer build warns (buffer < ep) and the step metric counts drops."""
+    from orion_tpu.parallel.mesh import MeshConfig
+    from orion_tpu.training.data import SyntheticDataset
+    from orion_tpu.training.trainer import TrainConfig, Trainer
+
+    def mk(buffer):
+        model = ModelConfig(
+            name="t", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+            max_seq_len=64, dtype="float32", n_experts=4, moe_period=2,
+            moe_top_k=2, moe_dropless=True, moe_ep_buffer=buffer,
+        )
+        return TrainConfig(
+            model=model, steps=1, batch_size=4, seq_len=16, lr=1e-3,
+            warmup_steps=1, mesh=MeshConfig(dp=2, ep=2), log_every=1,
+        )
+
+    batch = jnp.asarray(SyntheticDataset(64, 16).batch(0, 0, 4))
+    t = Trainer(mk(2.0))  # buffer == ep: mathematically dropless
+    m = t.step(batch)
+    assert "moe_overflow" in m and int(m["moe_overflow"]) == 0
+
+    with pytest.warns(UserWarning, match="moe_ep_buffer"):
+        t2 = Trainer(mk(0.05))
+    m2 = t2.step(batch)
+    assert int(m2["moe_overflow"]) > 0  # starved budget drops are visible
+
+
+def test_quantize_for_decode_rejects_dropless_ep_at_setup():
+    """ADVICE r4 (low): the quant x dropless x ep>1 combination fails as a
+    config-time ValueError with remediation, not an AssertionError deep in
+    jit tracing (the in-module assert remains as a backstop)."""
+    from orion_tpu.generate import quantize_for_decode
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = ModelConfig(
+        name="t", vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+        max_seq_len=64, dtype="float32", n_experts=4, moe_period=2,
+        moe_dropless=True,
+    )
+    mesh = make_mesh(MeshConfig(dp=1, ep=2))
+    model = TransformerLM(cfg, mesh=mesh)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(ValueError, match="capacity path"):
+        quantize_for_decode(model, params, mode="int8")
