@@ -110,6 +110,12 @@ def _build(batch_size: int, seq_len: int, config: str = "lm_1b3",
         warmup_steps=10,
         mesh=MeshConfig(dp=1),
         log_every=10**9,
+        # bf16 param storage + stochastic-rounding updates (VERDICT r4 #1):
+        # halves params AND grads in HBM, +4.1% over the fp32-master
+        # control at the same operating point (R5SWEEP.jsonl: 14,605 vs
+        # 14,028 tok/s, MFU 0.5712) — convergence parity in
+        # tests/test_training.py and the ENDURANCE_v2 run
+        param_storage="bfloat16_sr",
     )
     trainer = Trainer(cfg)
     batch = jnp.asarray(
@@ -307,7 +313,11 @@ def decode_matrix(batches=(1, 4, 8, 16, 32), prompt_len: int = 512,
     decode across batch sizes, so every cross-family ratio is same-run —
     no more cross-run 'relay drift' footnotes. Families run sequentially
     with an explicit free in between (16GB chip)."""
-    out = {"prompt_len": prompt_len, "n_tokens": n_tokens, "rows": {}}
+    # "errors" records WHY any null cell is null (VERDICT r4 weak #2: a
+    # hole in the canonical matrix with its cause only on transient stderr
+    # defeats the one-process matrix's purpose)
+    out = {"prompt_len": prompt_len, "n_tokens": n_tokens, "rows": {},
+           "errors": {}}
     fams = [
         ("dense_fp32", "lm_1b3", ""),
         ("dense_int8", "lm_1b3", "int8"),
@@ -329,9 +339,11 @@ def decode_matrix(batches=(1, 4, 8, 16, 32), prompt_len: int = 512,
                           file=sys.stderr)
                 except Exception as e:
                     row[f"b{b}"] = None
+                    out["errors"][f"{fam}.b{b}"] = str(e)[:300]
                     print(f"{fam} b{b} failed: {e}"[:200], file=sys.stderr)
             out["rows"][fam] = row
         except Exception as e:
+            out["errors"][fam] = str(e)[:300]
             print(f"{fam} failed: {e}"[:200], file=sys.stderr)
         finally:
             model = params = None  # noqa: F841
@@ -495,18 +507,29 @@ def main(argv=None) -> int:
             except Exception as e:
                 print(f"{name} failed: {e}"[:200], file=sys.stderr)
 
-    if args.moe:
+    if args.moe or not args.quick:
         # chip-scale sparse config: 1.89B total params, same 1.28B active
         # per token as the dense flagship (moe_1b3_8e at 4.1B is pod-only —
         # validated via the AOT path instead). The figure of merit is
         # tokens/sec vs the dense 1.3B — how much of the dense throughput
-        # survives routing + the extra expert HBM traffic.
-        moe = bench_train(iters=5 if args.quick else 10, config="moe_1b3_4e")
-        moe["config"] = "moe_1b3_4e"
-        moe["vs_dense_lm1b3"] = round(
-            moe["tokens_per_sec"] / res["tokens_per_sec"], 4
-        )
-        print(json.dumps({"moe_detail": moe}), file=sys.stderr)
+        # survives routing + the extra expert HBM traffic. In the DEFAULT
+        # (driver) run since r5: the r4 dropless headline numbers lived
+        # only in prose because the driver's flagless run never produced
+        # them (VERDICT r4 weak #1) — capacity AND dropless rows are now
+        # part of the round artifact.
+        _free_device_memory()
+        try:
+            moe = bench_train(
+                iters=5 if args.quick else 10, config="moe_1b3_4e"
+            )
+            moe["config"] = "moe_1b3_4e"
+            moe["vs_dense_lm1b3"] = round(
+                moe["tokens_per_sec"] / res["tokens_per_sec"], 4
+            )
+            print(json.dumps({"moe_detail": moe}), file=sys.stderr)
+        except Exception as e:
+            moe = None
+            print(f"moe capacity bench failed: {e}"[:200], file=sys.stderr)
         # dropless re-measure (VERDICT r3 #3a): the bitonic argsorts the r3
         # profile blamed are now a counting-sort + scatter
         _free_device_memory()
@@ -516,9 +539,10 @@ def main(argv=None) -> int:
                 moe_dropless=True,
             )
             dl["config"] = "moe_1b3_4e_dropless"
-            dl["vs_capacity"] = round(
-                dl["tokens_per_sec"] / moe["tokens_per_sec"], 4
-            )
+            if moe:
+                dl["vs_capacity"] = round(
+                    dl["tokens_per_sec"] / moe["tokens_per_sec"], 4
+                )
             print(json.dumps({"moe_dropless_detail": dl}), file=sys.stderr)
         except Exception as e:
             print(f"moe dropless bench failed: {e}"[:200], file=sys.stderr)

@@ -453,3 +453,140 @@ def test_eval_factory_batches_deterministic_per_step(tmp_path):
                     eval_data=_DATA("val.bin"), resume=True)
     # same step-4 eval data + bitwise-restored state -> identical eval loss
     np.testing.assert_allclose(a["eval_loss"], b["eval_loss"], rtol=1e-6)
+
+
+# -- param_storage="bfloat16_sr" (VERDICT r4 #1) ----------------------------
+
+
+def test_sr_round_bf16_unbiased_exact_and_nonfinite():
+    """The three SR contracts: (a) unbiased — the mean of many rounds
+    recovers the fp32 value far beyond bf16 precision; (b) exact — a value
+    already representable in bf16 round-trips bit-identically (a zero
+    update can never perturb params); (c) non-finite passthrough."""
+    from orion_tpu.training.trainer import sr_round_bf16
+
+    x = jnp.full((50000,), 1.0 + 2**-12, jnp.float32)  # between bf16 ulps
+    y = sr_round_bf16(x, jax.random.PRNGKey(0)).astype(jnp.float32)
+    # truncation would be off by 2**-12 ~ 2.4e-4; SR mean lands ~50x closer
+    assert abs(float(y.mean()) - float(x[0])) < 2e-5
+    # only the two bracketing neighbors ever appear
+    assert set(np.unique(np.asarray(y))) <= {1.0, 1.0078125}
+
+    z = jnp.asarray([1.5, -0.25, 0.0, 3.0], jnp.float32)  # bf16-exact
+    np.testing.assert_array_equal(
+        np.asarray(sr_round_bf16(z, jax.random.PRNGKey(1)).astype(jnp.float32)),
+        np.asarray(z),
+    )
+
+    nf = jnp.asarray([jnp.inf, -jnp.inf, jnp.nan], jnp.float32)
+    out = np.asarray(sr_round_bf16(nf, jax.random.PRNGKey(2)).astype(jnp.float32))
+    assert out[0] == np.inf and out[1] == -np.inf and np.isnan(out[2])
+
+
+def test_bf16_sr_storage_layout_and_convergence():
+    """bfloat16_sr stores matrix leaves bf16 (1D leaves stay fp32), the
+    optimizer stats stay fp32, and the overfit trajectory tracks the fp32-
+    master run closely (the convergence-parity evidence VERDICT r4 #1
+    asks for alongside the memory win)."""
+    data = FixedBatch(SMALL_MODEL.vocab_size, 32, 4)
+    results = {}
+    for storage in ("float32", "bfloat16_sr"):
+        cfg = small_cfg(steps=80, param_storage=storage)
+        trainer = Trainer(cfg)
+        if storage == "bfloat16_sr":
+            by_ndim = {True: set(), False: set()}
+            for l in jax.tree.leaves(trainer.state.params):
+                by_ndim[l.ndim >= 2].add(str(l.dtype))
+            assert by_ndim[True] == {"bfloat16"}, by_ndim
+            assert by_ndim[False] <= {"float32"}, by_ndim
+            for l in jax.tree.leaves(trainer.state.opt_state):
+                assert l.dtype != jnp.bfloat16, "opt stats must stay fp32"
+        it = _iter(data, cfg)
+        first = float(trainer.step(next(it))["loss"])
+        last = trainer.train(it)
+        results[storage] = (first, last["loss"])
+    f32_first, f32_last = results["float32"]
+    sr_first, sr_last = results["bfloat16_sr"]
+    # both overfit the fixed batch; SR lands within 25% of the fp32 loss
+    assert sr_last < sr_first / 8, results
+    assert abs(sr_last - f32_last) < 0.25 * max(f32_last, 0.05), results
+
+
+def test_bf16_sr_resume_bitwise(tmp_path):
+    """SR keys derive from (state.rng, step, leaf index) only, so a
+    killed+resumed bfloat16_sr run replays identical rounding — the A3
+    bitwise-resume guarantee survives the new storage mode."""
+    from orion_tpu.training.checkpoint import Checkpointer
+
+    cfg = small_cfg(
+        steps=6, ckpt_dir=str(tmp_path / "ckpt"), ckpt_every=3,
+        param_storage="bfloat16_sr",
+    )
+    ds = SyntheticDataset(cfg.model.vocab_size, cfg.seq_len)
+
+    trainer = Trainer(cfg)
+    ckpt = Checkpointer(cfg.ckpt_dir, save_every=cfg.ckpt_every, async_save=False)
+    trainer.train(_iter(ds, cfg), ckpt=ckpt)
+    final = jax.tree.map(np.asarray, trainer.state.params)
+    ckpt.close()
+
+    trainer2 = Trainer(cfg)
+    ckpt2 = Checkpointer(cfg.ckpt_dir, save_every=10_000, async_save=False)
+    start = trainer2.restore(ckpt2, step=3)
+    assert start == 3
+    trainer2.train(_iter(ds, cfg, start=start))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        final,
+        trainer2.state.params,
+    )
+    ckpt2.close()
+
+
+def test_bf16_sr_nan_guard_skips_update():
+    """The finite guard composes with SR: a poisoned step must leave the
+    bf16 params bit-identical (SR of a zero update is exact, and the
+    where(finite, ...) select keeps the old leaves)."""
+    cfg = small_cfg(steps=1, param_storage="bfloat16_sr")
+    trainer = Trainer(cfg)
+    params = trainer.state.params
+    flat, tree = jax.tree.flatten(params)
+    flat[0] = flat[0].at[...].set(jnp.inf)
+    trainer.state = trainer.state.replace(params=jax.tree.unflatten(tree, flat))
+    before = jax.tree.map(lambda x: np.asarray(x), trainer.state.params)
+    batch = jnp.asarray(
+        SyntheticDataset(cfg.model.vocab_size, cfg.seq_len).batch(0, 0, 4)
+    )
+    metrics = trainer.step(batch)
+    assert int(metrics["nonfinite"]) == 1
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        before, trainer.state.params,
+    )
+
+
+def test_bf16_sr_rejects_fused_optimizer():
+    with pytest.raises(ValueError, match="bfloat16_sr"):
+        Trainer(small_cfg(optimizer="adafactor_fused",
+                          param_storage="bfloat16_sr"))
+    with pytest.raises(ValueError, match="param_storage"):
+        Trainer(small_cfg(param_storage="float16"))
+
+
+def test_sr_noise_bits_uniform():
+    """The counter-hash noise source must make the SR selector's low 16
+    bits uniform — mean and per-bit balance within tight Monte-Carlo
+    bounds, plus no correlation with the counter parity (the Weyl input
+    is sequential)."""
+    from orion_tpu.training.trainer import _sr_noise_bits
+
+    r = np.asarray(
+        _sr_noise_bits(jax.random.PRNGKey(9), 1 << 20)
+    ) & 0xFFFF
+    n = r.size
+    assert abs(r.mean() - 32767.5) < 4 * (65536 / np.sqrt(12 * n))
+    for b in range(16):
+        frac = ((r >> b) & 1).mean()
+        assert abs(frac - 0.5) < 5 / np.sqrt(n), (b, frac)
+    even, odd = r[0::2].mean(), r[1::2].mean()
+    assert abs(even - odd) < 8 * (65536 / np.sqrt(12 * n / 2))
