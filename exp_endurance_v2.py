@@ -1,0 +1,171 @@
+"""ENDURANCE_v2 orchestrator (VERDICT r4 #2 + missing #1): the r4 endurance
+run proved the LOOP (loader wraparound, orbax-under-load, SIGKILL resume,
+throughput stability) but cycled a 3.7M-token corpus ~34x — held-out ppl
+bottomed at ~2250 steps and ROSE, i.e. the trajectory measured memorization.
+This run replaces that regime:
+
+- corpus: data/pretrain/ — 160M tokens in 10 shards sampled from the
+  interpolated-trigram source fitted on the real BPE corpus
+  (training/corpusgen.py; never repeats, entropy floor set by the
+  interpolation weights), streamed through ShardedTokenBinDataset + the
+  C++ loader's explicit-starts gather;
+- eval: data/pretrain/eval.bin — a held-out 2M-token sample (decorrelated
+  seed), evaluated every 250 steps through the STEP-KEYED eval_factory
+  (r4's fix, now exercised across a crash-resume end to end);
+- trainer: the r5 headline operating point — b12 x T2048, remat_skip=6,
+  adafactor, param_storage=bfloat16_sr (R5SWEEP.jsonl: 14,605 tok/s) —
+  so the convergence story covers the storage mode the benches ship;
+- same deliberate mid-async-save SIGKILL + crash-resume as v1.
+
+Success = monotone-falling held-out ppl across the full run (the r4
+failure mode), bitwise-consistent resume, flat tok/s, 0 non-finite steps.
+Writes ENDURANCE_V2.json; run on the real chip (hours).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+RUN_DIR = os.path.join(REPO, "runs", "endurance_v2")
+METRICS = os.path.join(RUN_DIR, "metrics.jsonl")
+LOG = os.path.join(RUN_DIR, "train.log")
+STEPS = 6000
+KILL_AT = 2620  # checkpoint lands at 2500; kill well into the next stretch
+
+CMD = [
+    sys.executable, "-m", "orion_tpu.train",
+    "--config", "lm_1b3",
+    "--data", os.path.join(REPO, "data", "pretrain"),
+    "--eval-data", os.path.join(REPO, "data", "pretrain", "eval.bin"),
+    "--eval-every", "250",
+    "--steps", str(STEPS),
+    "--batch-size", "12",
+    "--seq-len", "2048",
+    "--lr", "2e-4",
+    "--ckpt-dir", os.path.join(RUN_DIR, "ckpt"),
+    "--log-path", METRICS,
+    "--set", "model.remat_skip=6",
+    "--set", "optimizer=adafactor",
+    "--set", "param_storage=bfloat16_sr",
+    "--set", "warmup_steps=200",
+    "--set", "ckpt_every=500",
+    "--set", "log_every=20",
+]
+
+
+def read_metrics():
+    rows = []
+    if os.path.exists(METRICS):
+        with open(METRICS) as f:
+            for line in f:
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # torn tail line from the SIGKILL
+    return rows
+
+
+def last_step(rows):
+    return max((r["step"] for r in rows), default=0)
+
+
+def launch(log_f):
+    # own process group so the SIGKILL takes the prefetch thread's process
+    # tree with it, exactly like an OOM-killer or preemption would
+    return subprocess.Popen(
+        CMD, cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+
+
+def main() -> int:
+    os.makedirs(RUN_DIR, exist_ok=True)
+    t0 = time.time()
+    evidence = {"cmd": " ".join(CMD), "steps": STEPS, "kill_at": KILL_AT,
+                "corpus_tokens": 160_000_000, "eval_tokens": 2_000_000}
+
+    with open(LOG, "a", buffering=1) as log_f:
+        log_f.write(f"\n=== phase 1 launch {time.ctime()} ===\n")
+        proc = launch(log_f)
+        killed_at = None
+        while proc.poll() is None:
+            time.sleep(20)
+            s = last_step(read_metrics())
+            if s >= KILL_AT:
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                killed_at = s
+                break
+        if killed_at is None:
+            evidence["error"] = f"phase 1 exited rc={proc.returncode} before kill"
+            evidence["last_step"] = last_step(read_metrics())
+            with open(os.path.join(REPO, "ENDURANCE_V2.json"), "w") as f:
+                json.dump(evidence, f, indent=1)
+            return 1
+        evidence["killed_at_logged_step"] = killed_at
+        evidence["phase1_wall_s"] = round(time.time() - t0, 1)
+        log_f.write(f"\n=== SIGKILL at logged step {killed_at}; "
+                    f"relaunch {time.ctime()} ===\n")
+
+        t1 = time.time()
+        proc = launch(log_f)
+        rc = proc.wait()
+        evidence["phase2_rc"] = rc
+        evidence["phase2_wall_s"] = round(time.time() - t1, 1)
+
+    rows = read_metrics()
+    train_rows = [r for r in rows if "tokens_per_sec" in r]
+    eval_rows = [r for r in rows if "eval_ppl" in r]
+    steps_seen = [r["step"] for r in rows]
+    resume_overlap = sorted(
+        {s for s in steps_seen if steps_seen.count(s) > 1}
+    )
+    tps = [r["tokens_per_sec"] for r in train_rows]
+    q = max(1, len(tps) // 4)
+    # the headline claim, machine-checked: held-out ppl must fall across
+    # the run — compare each eval point to the best seen before it
+    traj = [
+        {"step": r["step"], "eval_ppl": round(r["eval_ppl"], 3)}
+        for r in eval_rows
+    ]
+    # dedupe resumed evals (same step twice): keep the LAST occurrence
+    dedup = {}
+    for r in traj:
+        dedup[r["step"]] = r["eval_ppl"]
+    ordered = [dedup[s] for s in sorted(dedup)]
+    rises = sum(
+        1 for i in range(1, len(ordered)) if ordered[i] > min(ordered[:i])
+    )
+    evidence.update({
+        "total_wall_s": round(time.time() - t0, 1),
+        "final_step": last_step(rows),
+        "log_rows": len(rows),
+        "tokens_trained": last_step(rows) * 12 * 2048,
+        "loss_first": train_rows[0]["loss"] if train_rows else None,
+        "loss_last": train_rows[-1]["loss"] if train_rows else None,
+        "eval_ppl_trajectory": traj,
+        "eval_ppl_first": ordered[0] if ordered else None,
+        "eval_ppl_last": ordered[-1] if ordered else None,
+        "eval_points_above_running_min": rises,
+        "tok_s_mean_first_quartile": round(sum(tps[:q]) / q, 1) if tps else None,
+        "tok_s_mean_last_quartile": round(sum(tps[-q:]) / q, 1) if tps else None,
+        "tok_s_min": round(min(tps), 1) if tps else None,
+        "tok_s_max": round(max(tps), 1) if tps else None,
+        "nonfinite_total": train_rows[-1].get("nonfinite_total") if train_rows else None,
+        "resumed_steps_recovered": resume_overlap[:5] + (["..."] if len(resume_overlap) > 5 else []),
+        "n_resumed_overlap_rows": len(resume_overlap),
+    })
+    with open(os.path.join(REPO, "ENDURANCE_V2.json"), "w") as f:
+        json.dump(evidence, f, indent=1)
+    print(json.dumps(evidence, indent=1))
+    return 0 if evidence.get("phase2_rc") == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

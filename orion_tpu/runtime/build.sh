@@ -4,6 +4,6 @@
 set -e
 cd "$(dirname "$0")"
 g++ -O3 -march=native -fPIC -shared -std=c++17 -pthread \
-    loader.cc tokenizer.cc bpe.cc \
+    loader.cc tokenizer.cc bpe.cc corpusgen.cc \
     -o liborion_runtime.so
 echo "built $(pwd)/liborion_runtime.so"

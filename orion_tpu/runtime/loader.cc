@@ -63,6 +63,18 @@ void gather_rows(const Loader* L, const uint64_t seed, const uint64_t step,
   }
 }
 
+template <typename T>
+void gather_explicit(const Loader* L, const int64_t* starts, int64_t row_begin,
+                     int64_t row_end, int32_t* out) {
+  const T* toks = reinterpret_cast<const T*>(L->data);
+  const int64_t w = L->seq_len + 1;
+  for (int64_t r = row_begin; r < row_end; ++r) {
+    int32_t* dst = out + r * w;
+    const T* src = toks + starts[r];
+    for (int64_t j = 0; j < w; ++j) dst[j] = static_cast<int32_t>(src[j]);
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -125,6 +137,36 @@ void orion_loader_batch(void* handle, uint64_t seed, uint64_t step,
   int64_t per = (batch + n_threads - 1) / n_threads;
   for (int t = 0; t < n_threads; ++t) {
     int64_t lo = t * per, hi = std::min<int64_t>(batch, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back(run, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// Fill out[n_rows, seq_len+1] from caller-provided window starts (the
+// sharded-dataset path: the global window -> (shard, local start) mapping
+// lives in Python — training/data.py::ShardedTokenBinDataset — and each
+// shard's rows arrive here as explicit local offsets).
+void orion_loader_gather(void* handle, const int64_t* starts, int64_t n_rows,
+                         int32_t* out, int n_threads) {
+  auto* L = static_cast<Loader*>(handle);
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n_rows) n_threads = static_cast<int>(n_rows);
+  auto run = [&](int64_t lo, int64_t hi) {
+    if (L->itemsize == 2) {
+      gather_explicit<uint16_t>(L, starts, lo, hi, out);
+    } else {
+      gather_explicit<uint32_t>(L, starts, lo, hi, out);
+    }
+  };
+  if (n_threads <= 1) {
+    run(0, n_rows);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t per = (n_rows + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * per, hi = std::min<int64_t>(n_rows, lo + per);
     if (lo >= hi) break;
     ts.emplace_back(run, lo, hi);
   }

@@ -93,10 +93,73 @@ class TokenBinDataset:
         same integer stream the C++ loader (runtime/loader.cc) computes, so
         the fallback and the native path are batch-for-batch identical."""
         starts = window_starts(seed, step, batch_size, self.n_windows)
-        out = np.empty((batch_size, self.seq_len + 1), dtype=np.int32)
+        return self.gather(starts)
+
+    def gather(self, starts: np.ndarray) -> np.ndarray:
+        """[len(starts), seq_len+1] int32 windows at explicit offsets (the
+        sharded-dataset building block; native twin in runtime)."""
+        out = np.empty((len(starts), self.seq_len + 1), dtype=np.int32)
         for i, s in enumerate(starts):
             out[i] = self.tokens[s : s + self.seq_len + 1]
         return out
+
+
+class ShardedTokenBinDataset:
+    """Many token-bin shards as ONE virtual corpus (VERDICT r4 #2: a
+    pretraining-scale corpus needn't be one file). The window space is the
+    concatenation of each shard's windows — a global start from
+    ``window_starts`` maps to (shard, local offset) by prefix-sum binary
+    search, so windows never span shard boundaries and the (seed, step) ->
+    batch contract is exactly the single-file one with ``n_windows =
+    sum_i n_windows_i``. Per-shard gathers ride the C++ loader's
+    explicit-starts entry (runtime/loader.cc::orion_loader_gather) when
+    the .so is built, the mmap fallback otherwise."""
+
+    def __init__(self, paths, seq_len: int):
+        assert paths, "ShardedTokenBinDataset needs at least one shard"
+        from orion_tpu import runtime
+
+        self.paths = list(paths)
+        self.seq_len = seq_len
+        # gate on the GATHER entry, not just native_available(): a stale
+        # pre-r5 .so loads fine but lacks orion_loader_gather, and the
+        # promised mmap fallback must engage instead of crashing at the
+        # first batch (r5 review)
+        lib = runtime._load() if runtime.native_available() else None
+        if lib is not None and hasattr(lib, "orion_loader_gather"):
+            self.shards = [
+                runtime.NativeTokenBinDataset(p, seq_len) for p in self.paths
+            ]
+        else:
+            self.shards = [TokenBinDataset(p, seq_len) for p in self.paths]
+        vocabs = {s.vocab_size for s in self.shards}
+        assert len(vocabs) == 1, (
+            f"shards disagree on vocab_size: { {p: s.vocab_size for p, s in zip(self.paths, self.shards)} }"
+        )
+        self.vocab_size = vocabs.pop()
+        per = np.asarray([s.n_windows for s in self.shards], dtype=np.int64)
+        assert (per > 0).all(), "every shard must hold > seq_len+1 tokens"
+        self._cum = np.cumsum(per)
+        self.n_windows = int(self._cum[-1])
+        self.n_tokens = int(sum(
+            getattr(s, "n_tokens", s.n_windows + seq_len + 1)
+            for s in self.shards
+        ))
+
+    def batch(self, seed: int, step: int, batch_size: int) -> np.ndarray:
+        starts = window_starts(seed, step, batch_size, self.n_windows)
+        which = np.searchsorted(self._cum, starts, side="right")
+        local = starts - np.concatenate([[0], self._cum[:-1]])[which]
+        out = np.empty((batch_size, self.seq_len + 1), dtype=np.int32)
+        for si in np.unique(which):
+            rows = np.nonzero(which == si)[0]
+            out[rows] = self.shards[si].gather(local[rows])
+        return out
+
+    def close(self):
+        for s in self.shards:
+            if hasattr(s, "close"):
+                s.close()
 
 
 class SyntheticDataset:
@@ -193,11 +256,22 @@ class DataLoader:
 
 
 def make_dataset(spec: str, seq_len: int, vocab_size: Optional[int] = None):
-    """'synthetic' or a token-bin path. Token-bin paths ride the C++ loader
+    """'synthetic', a token-bin path, a directory of ``shard_*.bin``, or a
+    comma-separated shard list. Token-bin paths ride the C++ loader
     (runtime/loader.cc) when the .so is present — batch-for-batch identical
     to the Python fallback (contract: tests/test_runtime.py)."""
     if spec == "synthetic":
         return SyntheticDataset(vocab_size or 256, seq_len)
+    if "," in spec:
+        return ShardedTokenBinDataset(
+            [p for p in spec.split(",") if p], seq_len
+        )
+    if os.path.isdir(spec):
+        import glob
+
+        paths = sorted(glob.glob(os.path.join(spec, "shard_*.bin")))
+        assert paths, f"{spec}: no shard_*.bin files (corpusgen layout)"
+        return ShardedTokenBinDataset(paths, seq_len)
     from orion_tpu.runtime import make_fastest_dataset
 
     return make_fastest_dataset(spec, seq_len)
@@ -205,6 +279,7 @@ def make_dataset(spec: str, seq_len: int, vocab_size: Optional[int] = None):
 
 __all__ = [
     "TokenBinDataset",
+    "ShardedTokenBinDataset",
     "SyntheticDataset",
     "DataLoader",
     "write_token_bin",
