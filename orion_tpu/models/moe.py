@@ -310,6 +310,22 @@ class MoEMLP(nn.Module):
                 "int8 dropless serving is single-host; use ep=1 or the "
                 "capacity path on ep meshes"
             )
+            from orion_tpu.ops.dispatch import resolve
+
+            b = resolve(cfg.backend)
+            n_row_shards = _data_shards(self.mesh)
+            n_tok = x.reshape(-1, d).shape[0]
+            # gmm form (VERDICT r4 #3a): needs a pallas backend, rows
+            # that divide the data axes, and training-scale local row
+            # counts (decode's tiny m keeps ragged_dot)
+            if (
+                b.startswith("pallas")
+                and n_tok % n_row_shards == 0
+                and (n_tok // n_row_shards) * cfg.moe_top_k >= 1024
+            ):
+                return self._dropless_ep_gmm(
+                    x, interpret=(b == "pallas_interpret")
+                )
             return self._dropless_ep(x)
         x2 = x.reshape(-1, d)
         n = x2.shape[0]
@@ -530,6 +546,153 @@ class MoEMLP(nn.Module):
         y = jnp.sum(y * gates[..., None].astype(dt), axis=1)
         return y.reshape(x.shape).astype(dt)
 
+    def _dropless_ep_gmm(self, x: Array, interpret: bool) -> Array:
+        """Dropless-ep with the grouped-matmul kernel INSIDE the ep region
+        (VERDICT r4 #3a: the scalable dropless form paid the ragged_dot
+        price the gmm kernel was built to remove).
+
+        Differences from the ragged ``_dropless_ep``:
+
+        - the shard_map is FULLY manual (every mesh axis named): jax's
+          tpu_custom_call lowering rejects Mosaic calls in partial-manual
+          regions (parallel/kernel_shard.py), so going fully manual is
+          what makes the kernel legal here at all;
+        - token rows are SHARDED over (dp, fsdp, sp) instead of
+          replicated — each shard sorts and serves only its local rows
+          (the ragged form recomputed every token on every ep shard);
+          the static budget applies per (data-shard, ep-shard):
+          ``ceil(moe_ep_buffer * m_local / ep)``, the same proportion of
+          local traffic the global budget gave;
+        - local rows scatter into TILE-ALIGNED per-expert segments (the
+          gmm contract) instead of a sorted prefix: in-budget local rows
+          go to ``seg_start[expert] + rank_within_expert``; remote and
+          over-budget rows collapse onto one trash row in a trailing
+          tile whose output is never gathered — no zero-expert
+          augmentation needed;
+        - expert weights are pcast data-axis-varying inside the body so
+          the shard_map transpose psums dw over the data axes (the same
+          idiom as ops/fused_ce.py::_sp_fused_ce).
+
+        Parity vs the ragged form and vs the single-host path:
+        tests/test_moe.py (interpret mode); the real-Mosaic compile is
+        covered by the fsdp x ep topology-AOT artifact and the driver
+        dryrun line."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from orion_tpu.ops.pallas.gmm import gmm, pad_group_sizes
+
+        cfg = self.cfg
+        dt, pdt = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        e, k, h = cfg.n_experts, cfg.moe_top_k, cfg.resolved_mlp_hidden
+        d = x.shape[-1]
+        mesh = self.mesh
+        s = mesh.shape
+        ep = s["ep"]
+        assert e % ep == 0, (e, ep)
+        el = e // ep
+        row_axes = _data_axes(mesh)
+        n_rows_shards = _data_shards(mesh)
+        x2 = x.reshape(-1, d)
+        n = x2.shape[0]
+        assert n % n_rows_shards == 0, (n, dict(s))
+        m_loc = (n // n_rows_shards) * k
+        budget = int(math.ceil(cfg.moe_ep_buffer * m_loc / ep))
+        budget = min(m_loc, max(el, (budget + 7) // 8 * 8))
+        tm, bh = (8, 128) if interpret else (128, 512)
+        # static scatter buffer: every in-budget row + <tm pad per local
+        # expert, tile-rounded, + one trailing trash tile for the rest
+        m2 = -(-(budget + el * tm) // tm) * tm
+        m2p = m2 + tm
+
+        logits, probs, ids, gates = self._route_flat(x2)
+
+        if cfg.mlp == "swiglu":
+            wg = self.param("experts_gate", _expert_init(), (e, d, h), pdt)
+            wu = self.param("experts_up", _expert_init(), (e, d, h), pdt)
+        else:
+            wg = None
+            wu = self.param("experts_up", _expert_init(), (e, d, h), pdt)
+        wdn = self.param("experts_down", _expert_init(), (e, h, d), pdt)
+
+        def body(xl, flat, *ws):
+            r = jax.lax.axis_index("ep")
+            lo = r * el
+            rot = (flat - lo) % e  # local experts become classes 0..el-1
+            _, rank, counts_rot = _counting_sort_perm(rot, e)
+            counts_local = counts_rot[:el]
+            cum = jnp.cumsum(counts_local)
+            cumc = jnp.minimum(cum, budget)
+            gs_local = jnp.diff(cumc, prepend=0)  # in-budget local counts
+            seg, seg_starts = pad_group_sizes(gs_local, tm)
+            offs_all = jnp.cumsum(counts_rot) - counts_rot  # class starts
+            within = rank - offs_all[rot]  # rank within own class
+            gs_all = jnp.concatenate(
+                [gs_local, jnp.zeros((e - el,), gs_local.dtype)]
+            )
+            starts_all = jnp.concatenate(
+                [seg_starts, jnp.zeros((e - el,), seg_starts.dtype)]
+            )
+            is_in = (rot < el) & (within < gs_all[rot])
+            pos = jnp.where(is_in, starts_all[rot] + within, m2)
+            xs = jnp.zeros((m2p, d), dt).at[pos].set(
+                jnp.take(xl.astype(dt), jnp.arange(m_loc) // k, axis=0)
+            )
+
+            if row_axes and not interpret:
+                # dw transpose -> psum over the data axes (the fused_ce
+                # idiom). Interpret mode runs check_vma=False, where the
+                # cast's transpose psum trips the variant check — the
+                # legacy spec-based transpose handles the replicated
+                # input there instead.
+                if hasattr(jax.lax, "pcast"):
+                    ws = tuple(
+                        jax.lax.pcast(w, row_axes, to="varying") for w in ws
+                    )
+                else:
+                    ws = tuple(jax.lax.pvary(w, row_axes) for w in ws)
+            if cfg.mlp == "swiglu":
+                wgl, wul, wdl = ws
+                mid = jax.nn.silu(
+                    gmm(xs, wgl.astype(dt), seg, tm, bh, interpret)
+                ) * gmm(xs, wul.astype(dt), seg, tm, bh, interpret)
+            else:
+                wul, wdl = ws
+                mid = jax.nn.gelu(gmm(xs, wul.astype(dt), seg, tm, bh, interpret))
+            ys = gmm(mid, wdl.astype(dt), seg, tm, bh, interpret)  # [M2p, d]
+
+            part = jnp.take(ys, pos, axis=0) * is_in[:, None].astype(dt)
+            part = jax.lax.psum(part, "ep")  # [m_loc, d]
+            dropped = jax.lax.psum(
+                cum[-1] - cumc[-1], ("ep",) + row_axes
+            )
+            return part, dropped
+
+        ws = tuple(w for w in (wg, wu, wdn) if w is not None)
+        rs = row_axes if row_axes else None
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(rs, None), P(rs))
+            + (P("ep", None, None),) * len(ws),
+            out_specs=(P(rs, None), P()),
+            axis_names=frozenset(mesh.axis_names),  # fully manual (Mosaic)
+            # vma on for real Mosaic (REQUIRED — tpu_custom_call rejects
+            # unchecked regions, parallel/kernel_shard.py); interpret-mode
+            # tracing cannot run under the check (same constraint as
+            # sequence.py/ring.py)
+            check_vma=not interpret,
+        )
+        part, dropped = fn(x2, ids.reshape(-1), *ws)
+
+        self._sow_flat_aux(logits, probs, ids)
+        if not self.is_initializing():
+            self.sow("moe_stats", "dropless_overflow", dropped)
+
+        y = part.reshape(n, k, d)
+        y = jnp.sum(y * gates[..., None].astype(dt), axis=1)
+        return y.reshape(x.shape).astype(dt)
+
     def _ep_constraint(self, t: Array) -> Array:
         """Pin the expert-major activation layout to the ep axis so GSPMD
         emits one all_to_all-class exchange instead of replicating
@@ -549,6 +712,21 @@ class MoEMLP(nn.Module):
                 t, NamedSharding(self.mesh, P(None, "ep", None, None))
             )
         return t
+
+
+def _data_axes(mesh) -> tuple:
+    """Token-row mesh axes (only those the mesh actually has — raw
+    ep-only test meshes exist). ONE definition shared by the gmm gate and
+    _dropless_ep_gmm so the two can never drift (r5 review)."""
+    return tuple(a for a in ("dp", "fsdp", "sp") if a in mesh.axis_names)
+
+
+def _data_shards(mesh) -> int:
+    s = mesh.shape
+    out = 1
+    for a in _data_axes(mesh):
+        out *= s.get(a, 1)
+    return out
 
 
 def _counting_sort_perm(flat: Array, n_classes: int):

@@ -40,7 +40,24 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from orion_tpu.ops.pallas.causal_dot import _sds  # vma-carrying out_shape:
+# lets these kernels compose with shard_map(check_vma=True) bodies (the
+# dropless-ep gmm region, models/moe.py::_dropless_ep_gmm)
+
 Array = jax.Array
+
+
+def _vma_union_like(a: Array, b: Array) -> Array:
+    """Zero-size value carrying the UNION of two operands' varying-mesh-
+    axes types (e.g. x varying over data axes, w varying over ep): the
+    product's vma is the union, and the slice keeps it costless."""
+    return a.reshape(-1)[:1] * b.reshape(-1)[:1].astype(a.dtype)
+
+
+# dw-kernel output tile (see _gmm_bwd): chip-swept at the flagship
+# dropless shapes (exp_r5gmm.py -> R5GMM.jsonl)
+_DW_BLOCK_D = 1024
+_DW_BLOCK_H = 1024
 
 
 def tile_expert_table(group_sizes: Array, n_tiles: int, tile_rows: int) -> Array:
@@ -86,7 +103,7 @@ def _gmm_call(x, w, tile_expert, tile_rows, block_h, interpret):
     )
     out = pl.pallas_call(
         _fwd_kernel,
-        out_shape=jax.ShapeDtypeStruct((m, hp), x.dtype),
+        out_shape=_sds((m, hp), x.dtype, _vma_union_like(x, w)),
         grid_spec=grid_spec,
         interpret=interpret,
     )(tile_expert, x, w)
@@ -139,8 +156,9 @@ def _dw_call(x, g, tile_expert, n_experts, tile_rows, block_d, block_h,
     )
     dw = pl.pallas_call(
         _dw_kernel,
-        out_shape=jax.ShapeDtypeStruct(
-            (n_experts, nd * block_d, nh * block_h), jnp.float32
+        out_shape=_sds(
+            (n_experts, nd * block_d, nh * block_h), jnp.float32,
+            _vma_union_like(x, g),
         ),
         grid_spec=grid_spec,
         interpret=interpret,
@@ -189,12 +207,17 @@ def _gmm_bwd(tile_rows, block_h, interpret, res, dy):
     dx = _gmm_call(
         dyc, jnp.swapaxes(wc, 1, 2), te, tile_rows, block_h, interpret
     ).astype(x.dtype)
-    # dw tiles are independent of the fwd/dx block_h: 512x512 is the
-    # measured VMEM-feasible optimum at flagship shapes (docstring),
-    # clamped down for small-shape callers (interpret-mode tests)
+    # dw tiles are independent of the fwd/dx block_h. The dw stream
+    # traffic is nd*nh*(M*(block_d+block_h)) — x re-read nh times, dy
+    # re-read nd times — so bigger blocks directly cut the backward's
+    # HBM bill; the (1, bd, bh) fp32 dw block is the VMEM bound
+    # (1024x1024 = 4MB, well under the 16MB stack — the r4 OOM note was
+    # the FWD kernel's [d, block_h] weight blocks, not these).
+    # R5GMM.jsonl: dw-block sweep at the flagship dropless shapes.
     dw = _dw_call(
         x, dyc, te, e, tile_rows,
-        min(512, x.shape[1]), min(512, dy.shape[1]), interpret,
+        min(_DW_BLOCK_D, x.shape[1]), min(_DW_BLOCK_H, dy.shape[1]),
+        interpret,
     )
     # an expert with ZERO tiles never has its dw block written — the out
     # buffer holds uninitialized memory there, so mask by presence (pad

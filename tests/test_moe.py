@@ -982,3 +982,93 @@ def test_quantize_for_decode_rejects_dropless_ep_at_setup():
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
     with pytest.raises(ValueError, match="capacity path"):
         quantize_for_decode(model, params, mode="int8")
+
+
+class TestDroplessEpGmm:
+    """VERDICT r4 #3a: the grouped-matmul kernel INSIDE the (fully-manual)
+    ep region — the scalable dropless form no longer pays the ragged_dot
+    price. Interpret-mode kernels here; the real-Mosaic compile is the
+    fsdp x ep topology-AOT artifact + the driver dryrun line."""
+
+    KW = dict(name="t", d_model=32, n_experts=4, dtype="float32",
+              moe_dropless=True, moe_ep_buffer=2.0)
+
+    def _models(self, mesh, k=2):
+        cfg_i = ModelConfig(backend="pallas_interpret", moe_top_k=k, **self.KW)
+        cfg_x = ModelConfig(backend="xla", moe_top_k=k, **self.KW)
+        return MoEMLP(cfg_x), MoEMLP(cfg_i, mesh=mesh), MoEMLP(cfg_x, mesh=mesh)
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_forward_matches_single_host_and_ragged(self, k):
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(dp=2, ep=2))
+        # n_loc * k >= 1024 satisfies the gmm gate on the dp2 mesh
+        x = jax.random.normal(jax.random.PRNGKey(3), (4, 512 // k, 32))
+        m_ref, m_gmm, m_rag = self._models(mesh, k)
+        p = m_ref.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 32)))
+        y_ref = jax.jit(m_ref.apply)(p, x)
+        y_gmm = jax.jit(m_gmm.apply)(p, x)
+        y_rag = jax.jit(m_rag.apply)(p, x)
+        np.testing.assert_allclose(
+            np.asarray(y_gmm), np.asarray(y_ref), atol=2e-5, rtol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(y_gmm), np.asarray(y_rag), atol=2e-5, rtol=2e-5
+        )
+
+    @pytest.mark.slow
+    def test_grads_match_single_host(self):
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(dp=2, ep=2))
+        x = jax.random.normal(jax.random.PRNGKey(5), (4, 256, 32))
+        m_ref, m_gmm, _ = self._models(mesh)
+        p = m_ref.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 32)))
+
+        def loss(m):
+            def f(p):
+                y, aux = m.apply(p, x, mutable=["losses", "moe_stats"])
+                return (y**2).mean() + sum(jax.tree.leaves(aux["losses"]))
+            return f
+
+        gr = jax.jit(jax.grad(loss(m_ref)))(p)
+        gg = jax.jit(jax.grad(loss(m_gmm)))(p)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5
+            ),
+            gr, gg,
+        )
+
+    def test_starved_budget_counts_drops(self):
+        """The budget semantics carry over: a starved moe_ep_buffer drops
+        past the per-shard budget, COUNTED in moe_stats, finite outputs."""
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        kw = dict(self.KW, moe_ep_buffer=0.05)
+        cfg = ModelConfig(backend="pallas_interpret", moe_top_k=1, **kw)
+        mesh = make_mesh(MeshConfig(dp=1, ep=2))
+        m = MoEMLP(cfg, mesh=mesh)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 512, 32))
+        p = m.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 32)))
+        y, aux = jax.jit(
+            lambda p, x: m.apply(p, x, mutable=["losses", "moe_stats"])
+        )(p, x)
+        assert np.isfinite(np.asarray(y)).all()
+        (dropped,) = jax.tree.leaves(aux["moe_stats"])
+        assert int(dropped) > 0
+
+    def test_decode_rows_keep_ragged(self):
+        """Tiny-m calls (decode) must NOT take the gmm path — the GEMV-
+        sized scatter would be all padding; gate falls through to the
+        ragged dropless-ep body."""
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        cfg = ModelConfig(backend="pallas_interpret", moe_top_k=1, **self.KW)
+        mesh = make_mesh(MeshConfig(dp=1, ep=2))
+        m = MoEMLP(cfg, mesh=mesh)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 32))  # decode rank-2
+        p = m.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 32)))
+        y = jax.jit(m.apply)(p, x)  # would fail inside gmm if gated wrong
+        assert np.isfinite(np.asarray(y)).all()
