@@ -37,6 +37,14 @@ class ModelConfig:
     norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
     layer_types: Optional[Tuple[str, ...]] = None  # default all "linear"
     window: int = 512  # swa window
+    # flash-attention tile sizes for the SINGLE-SHARD causal softmax/swa
+    # flash paths (train __call__ and prefill; the sp ring/halo bodies
+    # carry their own block constants in parallel/ring.py). With the
+    # banded swa grid (ops/pallas/flash_attention.py, r5) smaller
+    # attn_block_k trims boundary-tile mask padding without growing the
+    # sweep; chip-swept in exp_r5swa.py
+    attn_block_q: int = 512
+    attn_block_k: int = 512
     feature_map: str = "elu1"  # linear-attn phi
     max_seq_len: int = 2048
     tie_embeddings: bool = True

@@ -298,6 +298,7 @@ class Attention(nn.Module):
                     lambda a, b, c: softmax_attention(
                         a, b, c, causal=True, window=window,
                         backend=cfg.backend,
+                        block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
                     ),
                     q, k, v,
                 )
@@ -337,6 +338,7 @@ class Attention(nn.Module):
                     lambda a, b, c: softmax_attention(
                         a, b, c, causal=True, window=cfg.window,
                         backend=cfg.backend,
+                        block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
                     ),
                     qr, kr, v,
                 )
@@ -344,7 +346,8 @@ class Attention(nn.Module):
             else:
                 out = self._kernel_bh(
                     lambda a, b, c: softmax_attention(
-                        a, b, c, causal=True, backend=cfg.backend
+                        a, b, c, causal=True, backend=cfg.backend,
+                        block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
                     ),
                     qr, kr, v,
                 )

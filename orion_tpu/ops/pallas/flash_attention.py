@@ -80,24 +80,81 @@ def _rowscol(qi, ki, bq, bk):
     return rows, cols
 
 
+# benchmark switch (exp_r5swa.py): False restores the full quadratic grid
+# so the clip-vs-mask delta is measurable on the SAME build
+_BANDED_ENABLED = True
+
+
+def _banded_ok(causal, window, shift, q_offset, t_q, t_k) -> bool:
+    """Use the BANDED grid (VERDICT r4 #6 — clip, don't mask): the k sweep
+    per q-tile covers only tiles intersecting the (window, causal) band
+    via a qi-dependent BlockSpec index map. Cuts the swept area from
+    O(T^2) grid steps to O(T*window) AND makes small block_k affordable —
+    the boundary tiles' masked padding shrinks with bk, which the full
+    quadratic grid couldn't exploit (its step count scaled with 1/bk over
+    the WHOLE row). Plain single-shard swa only: the ring/halo callers
+    (shift/q_offset) keep the classic grid, whose skip predicate already
+    serves their offset geometry."""
+    return (
+        _BANDED_ENABLED
+        and causal and window is not None and shift == 0 and q_offset == 0
+        and t_q == t_k and window < t_k
+    )
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
 
 
+def _banded_base(qi, bq, bk, window):
+    """First k-tile of query tile ``qi``'s band (may be negative near the
+    sequence start — callers clip the fetch and skip the compute)."""
+    return (qi * bq - window + 1) // bk
+
+
+def _banded_nj(nq: int, bq: int, bk: int, window: int) -> int:
+    """Grid extent of the banded k sweep: max tiles any q-tile's band
+    touches (exact python max, not a bound — nq is at most thousands)."""
+    m = 1
+    for qi in range(nq):
+        base = (qi * bq - window + 1) // bk
+        m = max(m, (qi * bq + bq - 1) // bk - base + 1)
+    return m
+
+
+def _banded_q_nj(nk: int, bq: int, bk: int, window: int) -> int:
+    """Grid extent of the banded q sweep (dk/dv kernel): max q-tiles any
+    k-tile's band touches."""
+    m = 1
+    for ki in range(nk):
+        base = (ki * bk) // bq
+        m = max(m, (ki * bk + bk + window - 2) // bq - base + 1)
+    return m
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, window, shift, q_offset, t_k, bq, bk, nk,
+    *, scale, causal, window, shift, q_offset, t_k, bq, bk, nk, banded,
+    nk_real,
 ):
-    qi, ki = pl.program_id(1), pl.program_id(2)
+    qi, j = pl.program_id(1), pl.program_id(2)
+    if banded:  # k-tile index is band-relative (swa clip, module docstring)
+        ki = _banded_base(qi, bq, bk, window) + j
+        oob = (ki < 0) | (ki >= nk_real)
+    else:
+        ki = j
+        oob = jnp.bool_(False)
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _():
         m_scr[:] = jnp.full_like(m_scr, _NEG)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift, q_offset)))
+    @pl.when(jnp.logical_not(
+        oob | _skip_tile(qi, ki, bq, bk, causal, window, shift, q_offset)
+    ))
     def _():
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0],
@@ -117,7 +174,7 @@ def _fwd_kernel(
         )
         m_scr[:] = m_new
 
-    @pl.when(ki == nk - 1)
+    @pl.when(j == nk - 1)
     def _():
         l = l_scr[:]
         safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (padding) -> 0
@@ -136,18 +193,28 @@ def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret, shift=0,
     vp = jnp.pad(v, ((0, 0), (0, pk), (0, 0))) if pk else v
     nq, nk = qp.shape[1] // bq, kp.shape[1] // bk
 
+    banded = _banded_ok(causal, window, shift, q_offset, t_q, t_k)
+    if banded:
+        grid_k = _banded_nj(nq, bq, bk, window)
+        kvmap = lambda b, i, j: (  # noqa: E731
+            b, jnp.clip(_banded_base(i, bq, bk, window) + j, 0, nk - 1), 0
+        )
+    else:
+        grid_k = nk
+        kvmap = lambda b, i, j: (b, j, 0)  # noqa: E731
+
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, window=window, shift=shift,
         q_offset=q_offset,
-        t_k=t_k, bq=bq, bk=bk, nk=nk,
+        t_k=t_k, bq=bq, bk=bk, nk=grid_k, banded=banded, nk_real=nk,
     )
     out, lse = pl.pallas_call(
         kern,
-        grid=(bh, nq, nk),
+        grid=(bh, nq, grid_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, dv), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kvmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), kvmap, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, dv), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
@@ -174,15 +241,24 @@ def _flash_fwd_flat(q, k, v, scale, causal, window, bq, bk, interpret, shift=0,
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale, causal, window, shift, q_offset, t_k, bq, bk, nk,
+    *, scale, causal, window, shift, q_offset, t_k, bq, bk, nk, banded,
+    nk_real,
 ):
-    qi, ki = pl.program_id(1), pl.program_id(2)
+    qi, j = pl.program_id(1), pl.program_id(2)
+    if banded:
+        ki = _banded_base(qi, bq, bk, window) + j
+        oob = (ki < 0) | (ki >= nk_real)
+    else:
+        ki = j
+        oob = jnp.bool_(False)
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift, q_offset)))
+    @pl.when(jnp.logical_not(
+        oob | _skip_tile(qi, ki, bq, bk, causal, window, shift, q_offset)
+    ))
     def _():
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0],
@@ -202,7 +278,7 @@ def _dq_kernel(
             ds, k_ref[0].astype(jnp.float32), preferred_element_type=jnp.float32
         )
 
-    @pl.when(ki == nk - 1)
+    @pl.when(j == nk - 1)
     def _():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
@@ -210,16 +286,25 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale, causal, window, shift, q_offset, t_k, bq, bk, nq,
+    *, scale, causal, window, shift, q_offset, t_k, bq, bk, nq, banded,
+    nq_real,
 ):
-    ki, qi = pl.program_id(1), pl.program_id(2)
+    ki, j = pl.program_id(1), pl.program_id(2)
+    if banded:  # q-tile index is band-relative: q rows in [ki*bk, ki*bk+bk+w)
+        qi = (ki * bk) // bq + j
+        oob = qi >= nq_real
+    else:
+        qi = j
+        oob = jnp.bool_(False)
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(jnp.logical_not(_skip_tile(qi, ki, bq, bk, causal, window, shift, q_offset)))
+    @pl.when(jnp.logical_not(
+        oob | _skip_tile(qi, ki, bq, bk, causal, window, shift, q_offset)
+    ))
     def _():
         # q-major (Bq, Bk) tile; k-side grads via contraction over the q dim
         s = jax.lax.dot_general(
@@ -247,7 +332,7 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(qi == nq - 1)
+    @pl.when(j == nq - 1)
     def _():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -279,20 +364,30 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
     )
     nq, nk = qp.shape[1] // bq, kp.shape[1] // bk
 
+    banded = _banded_ok(causal, window, shift, q_offset, t_q, t_k)
+    if banded:
+        grid_k = _banded_nj(nq, bq, bk, window)
+        kvmap = lambda b, i, j: (  # noqa: E731
+            b, jnp.clip(_banded_base(i, bq, bk, window) + j, 0, nk - 1), 0
+        )
+    else:
+        grid_k = nk
+        kvmap = lambda b, i, j: (b, j, 0)  # noqa: E731
+
     col_spec_q = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
 
     dq_kern = functools.partial(
         _dq_kernel, scale=scale, causal=causal, window=window, shift=shift,
         q_offset=q_offset,
-        t_k=t_k, bq=bq, bk=bk, nk=nk,
+        t_k=t_k, bq=bq, bk=bk, nk=grid_k, banded=banded, nk_real=nk,
     )
     dq = pl.pallas_call(
         dq_kern,
-        grid=(bh, nq, nk),
+        grid=(bh, nq, grid_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, dv), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kvmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, dv), kvmap, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, dv), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
             col_spec_q,
             col_spec_q,
@@ -305,22 +400,29 @@ def _flash_bwd_flat(q, k, v, out, lse, g, scale, causal, window, bq, bk, interpr
         interpret=interpret,
     )(qp, kp, vp, gp, lsep, deltap)
 
-    col_spec_q_inner = pl.BlockSpec(
-        (1, bq, 1), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM
-    )
+    if banded:
+        grid_q = _banded_q_nj(nk, bq, bk, window)
+        qmap = lambda b, j, i: (  # noqa: E731
+            b, jnp.clip((j * bk) // bq + i, 0, nq - 1), 0
+        )
+    else:
+        grid_q = nq
+        qmap = lambda b, j, i: (b, i, 0)  # noqa: E731
+
+    col_spec_q_inner = pl.BlockSpec((1, bq, 1), qmap, memory_space=pltpu.VMEM)
     dkv_kern = functools.partial(
         _dkv_kernel, scale=scale, causal=causal, window=window, shift=shift,
         q_offset=q_offset,
-        t_k=t_k, bq=bq, bk=bk, nq=nq,
+        t_k=t_k, bq=bq, bk=bk, nq=grid_q, banded=banded, nq_real=nq,
     )
     dk, dv_ = pl.pallas_call(
         dkv_kern,
-        grid=(bh, nk, nq),
+        grid=(bh, nk, grid_q),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), qmap, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bk, dv), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, dv), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, dv), qmap, memory_space=pltpu.VMEM),
             col_spec_q_inner,
             col_spec_q_inner,
         ],
