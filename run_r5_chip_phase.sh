@@ -1,0 +1,13 @@
+#!/bin/sh
+# Round-5 post-endurance chip phase: every remaining measurement in one
+# sequential pass over the single chip (contention-free ordering).
+set -x
+cd /root/repo
+# 1. gmm dw-block sweep (VERDICT r4 #4 diagnosis follow-up)
+python exp_r5gmm.py >> R5GMM.jsonl 2>stderr_r5gmm.log
+# 2. banded-swa kernel sweep + full hybrid step + same-run dense ratio
+python exp_r5swa.py >> R5SWA.jsonl 2>stderr_r5swa.log
+# 3. the full bench: headline + decode matrix (incl int4 re-measure with
+#    recorded error causes) + hybrid rows + moe capacity/dropless rows
+python bench.py > BENCH_R5_LOCAL.json 2> BENCH_R5_LOCAL.stderr
+echo DONE
