@@ -164,7 +164,9 @@ def _operating_points(config: str, seq_len: int):
             return [(b0, 4), (max(1, b0 // 2), 6), (1, 8)]
         return [(12, 6), (16, 4), (8, 8), (4, 8), (2, 8), (1, 8)]
     if config == "hybrid_1b3":
-        return [(12, 6), (16, 4), (8, 6), (4, 6), (2, 6), (1, 6)]
+        # bf16_sr r5 sweep (R5SWEEP.jsonl): skip10 beats skip6 by 1.7%
+        # (non-monotone — skip8 regresses; XLA buffer-assignment cliff)
+        return [(12, 10), (12, 6), (16, 4), (8, 6), (4, 6), (2, 6), (1, 6)]
     if config == "moe_1b3_4e":  # expert weights shrink the skip budget
         # monotone by expected footprint: after a (12,4) OOM a LARGER batch
         # cannot fit either (ADVICE r3 #4 — the old (16,0) entry here just
