@@ -58,7 +58,7 @@ def bench_kernel(bq, bk, banded, iters=30):
 
 
 def bench_step(tag, config, bq=512, bk=512, iters=10):
-    import exp_r5sweep  # reuse the trainer-step harness
+
 
     import orion_tpu.ops.pallas.flash_attention as fa
 

@@ -590,3 +590,39 @@ def test_sr_noise_bits_uniform():
         assert abs(frac - 0.5) < 5 / np.sqrt(n), (b, frac)
     even, odd = r[0::2].mean(), r[1::2].mean()
     assert abs(even - odd) < 8 * (65536 / np.sqrt(12 * n / 2))
+
+
+def test_train_cli_sharded_corpus_bf16_sr(tmp_path):
+    """The ENDURANCE_v2 recipe end-to-end at test scale: corpusgen shards
+    -> --data <dir> through the sharded loader -> bfloat16_sr training
+    with step-keyed eval on the held-out shard."""
+    import numpy as np
+
+    from orion_tpu.train import train as train_fn
+    from orion_tpu.training.corpusgen import generate_shards
+    from orion_tpu.training.data import write_token_bin
+
+    src = str(tmp_path / "src.bin")
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 40, 6000)
+    write_token_bin(src, ((a * 37 + np.roll(a, 1)) % 997).astype(np.uint16),
+                    vocab_size=1024)
+    out = str(tmp_path / "corpus")
+    generate_shards(src, out, shards=2, tokens_per_shard=3000, seed=5,
+                    eval_tokens=1500)
+
+    from orion_tpu.models.configs import ModelConfig
+    from orion_tpu.parallel.mesh import MeshConfig
+    from orion_tpu.training.trainer import TrainConfig
+
+    cfg = TrainConfig(
+        model=ModelConfig(name="t", vocab_size=1024, d_model=32, n_layers=2,
+                          n_heads=2, max_seq_len=33, dtype="float32"),
+        steps=4, batch_size=2, seq_len=32, lr=1e-3, warmup_steps=1,
+        log_every=2, eval_every=2, eval_batches=2,
+        ckpt_dir=str(tmp_path / "ckpt"), ckpt_every=2,
+        mesh=MeshConfig(dp=1), param_storage="bfloat16_sr",
+    )
+    _, last = train_fn(cfg, data=out, eval_data=out + "/eval.bin",
+                       resume=False)
+    assert np.isfinite(last["loss"]) and np.isfinite(last["eval_loss"])
