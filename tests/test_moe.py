@@ -1154,6 +1154,30 @@ class TestDroplessDenseMeshGmm:
             gr, gg,
         )
 
+    def test_misaligned_rows_keep_ragged(self, monkeypatch):
+        """Token counts that don't divide the data shards must fall back
+        to the ragged GSPMD body (the manual region's P(rs) in_spec needs
+        equal shards) — poisoned entry pins the routing."""
+        from orion_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        monkeypatch.setattr(
+            MoEMLP, "_dropless_ep_gmm",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("gmm region must not engage on misaligned rows")
+            ),
+        )
+        mesh = make_mesh(MeshConfig(dp=4))
+        # 3 x 683 = 2049 tokens: 2049 % 4 == 1 trips ONLY the divisibility
+        # guard — the row-count gate would pass ((2049 // 4) * k2 = 1024)
+        x = jax.random.normal(jax.random.PRNGKey(3), (3, 683, 32))
+        m_ref, m_gmm, _ = self._models(mesh)
+        p = m_ref.init(jax.random.PRNGKey(1), jnp.zeros((2, 16, 32)))
+        y_ref = jax.jit(m_ref.apply)(p, x)
+        y = jax.jit(m_gmm.apply)(p, x)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(y_ref), atol=2e-5, rtol=2e-5
+        )
+
     @pytest.mark.parametrize("mesh_kw", [dict(dp=2, tp=2), dict(dp=2, pp=2)])
     def test_tp_pp_meshes_keep_ragged(self, mesh_kw, monkeypatch):
         """tp/pp > 1 must NOT take the manual gmm region (the region
