@@ -1,0 +1,30 @@
+"""Static analysis for orion-tpu: AST lint rules + jaxpr contract audits.
+
+Two tiers, one CLI (``python -m orion_tpu.analysis``), both part of tier-1
+via tests/test_analysis.py:
+
+- **Tier A** (analysis/lint.py, analysis/rules/): AST lint over the package —
+  JAX hazards (debug calls and tracer materialization under jit, unhashable
+  static args, Python-loop jnp accumulation in hot paths, float64 leaks) and
+  repo contracts (pallas chunk guards, mutable defaults, bare excepts).
+- **Tier B** (analysis/jaxpr_audit.py): traces — never executes — the jitted
+  train step, the LRA step, and the recurrent decode step on abstract shapes
+  and asserts the declared contracts (collective-free O(1)-state decode,
+  bf16 matmul policy, no host callbacks).
+
+Suppression: ``# orion: noqa[rule-id]`` on the finding's line; grandfathered
+findings live in analysis/baseline.json with a mandatory rationale.
+"""
+
+from orion_tpu.analysis.findings import (  # noqa: F401
+    BaselineEntry,
+    Finding,
+    apply_baseline,
+    load_baseline,
+)
+from orion_tpu.analysis.lint import lint_paths, lint_source  # noqa: F401
+
+__all__ = [
+    "Finding", "BaselineEntry", "load_baseline", "apply_baseline",
+    "lint_source", "lint_paths",
+]

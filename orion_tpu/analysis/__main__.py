@@ -1,0 +1,88 @@
+"""``python -m orion_tpu.analysis`` — run both analysis tiers; exit non-zero
+on any finding that is neither ``# orion: noqa[rule-id]``-suppressed nor
+baselined (analysis/baseline.json) with a rationale."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "orion_tpu.analysis",
+        description="orion-tpu static analysis: AST lint + jaxpr contracts",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to lint (default: the orion_tpu package)",
+    )
+    p.add_argument(
+        "--tier", choices=["lint", "jaxpr", "all"], default="all",
+        help="lint = Tier A AST rules only; jaxpr = Tier B contract audit "
+        "only (traces the train/LRA/decode steps on abstract shapes)",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON (default: orion_tpu/analysis/baseline.json); "
+        "'none' disables baselining",
+    )
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule/contract catalog and exit")
+    args = p.parse_args(argv)
+
+    from orion_tpu.analysis import jaxpr_audit
+    from orion_tpu.analysis.findings import (
+        DEFAULT_BASELINE,
+        Finding,
+        apply_baseline,
+        load_baseline,
+    )
+    from orion_tpu.analysis.lint import lint_paths
+    from orion_tpu.analysis.rules import ALL_RULES
+
+    if args.list_rules:
+        print("Tier A (AST lint):")
+        for rule in ALL_RULES.values():
+            print(f"  {rule.id:<20} {rule.title}")
+        print("Tier B (jaxpr contracts):")
+        for cid in jaxpr_audit.ALL_CONTRACTS:
+            print(f"  {cid}")
+        return 0
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    paths = args.paths or [os.path.join(repo_root, "orion_tpu")]
+
+    if args.baseline == "none":
+        baseline = []
+    else:
+        baseline = load_baseline(args.baseline or DEFAULT_BASELINE)
+
+    findings: List[Finding] = []
+    if args.tier in ("lint", "all"):
+        findings += lint_paths(paths, baseline=baseline, root=repo_root)
+    if args.tier in ("jaxpr", "all"):
+        findings += apply_baseline(jaxpr_audit.audit_repo(), baseline)
+
+    for f in findings:
+        print(f.format())
+    n = len(findings)
+    tiers = {"lint": "tier A", "jaxpr": "tier B", "all": "tiers A+B"}
+    if n:
+        print(
+            f"\n{n} finding(s) ({tiers[args.tier]}). Fix them, suppress a "
+            "false positive in-line with `# orion: noqa[rule-id]`, or "
+            "baseline it with a reason in orion_tpu/analysis/baseline.json.",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"analysis clean ({tiers[args.tier]})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Shared finding/baseline plumbing for both analysis tiers.
+
+A ``Finding`` is one violation: a rule id, a repo-relative location, and a
+message. Tier A (analysis/lint.py) produces them from AST checks; tier B
+(analysis/jaxpr_audit.py) from traced-jaxpr contracts. The CLI
+(``python -m orion_tpu.analysis``) exits non-zero on any finding that is
+neither suppressed in-line (``# orion: noqa[rule-id]``) nor grandfathered in
+the baseline file.
+
+Baseline format (analysis/baseline.json)::
+
+    {"entries": [{"rule": "<rule-id>", "path": "<repo-relative>",
+                  "reason": "<why this is a false positive>"}]}
+
+Entries match every finding of ``rule`` in ``path`` — file granularity, so
+baselines survive unrelated line churn. ``reason`` is mandatory: a baseline
+without a rationale is just a muted alarm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Iterable, List, Sequence
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path (or "<target>" for jaxpr audits)
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def normalize_path(path: str, root: str = "") -> str:
+    """Repo-relative posix form so baselines/noqa match on any machine."""
+    p = os.path.abspath(path)
+    root = os.path.abspath(root or os.getcwd())
+    if p.startswith(root + os.sep):
+        p = p[len(root) + 1:]
+    return p.replace(os.sep, "/")
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    path: str
+    reason: str
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> List[BaselineEntry]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = []
+    for e in data.get("entries", []):
+        if not e.get("reason", "").strip():
+            raise ValueError(
+                f"baseline entry {e!r} has no reason; every grandfathered "
+                "finding must say why it is a false positive"
+            )
+        entries.append(
+            BaselineEntry(rule=e["rule"], path=e["path"], reason=e["reason"])
+        )
+    return entries
+
+
+def apply_baseline(
+    findings: Iterable[Finding], baseline: Sequence[BaselineEntry]
+) -> List[Finding]:
+    keys = {(b.rule, b.path) for b in baseline}
+    return [f for f in findings if (f.rule, f.path) not in keys]
+
+
+__all__ = [
+    "Finding", "BaselineEntry", "load_baseline", "apply_baseline",
+    "normalize_path", "DEFAULT_BASELINE",
+]

@@ -1,0 +1,286 @@
+"""Tier A: AST lint engine for orion-specific JAX hazards.
+
+Rules live in ``analysis/rules/`` and are pure AST checks — no imports of the
+code under analysis, so a lint pass can never crash on (or be slowed by) the
+modules it audits. Each rule gets a :class:`ModuleContext` with the parsed
+tree plus the two pieces of derived information most rules share:
+
+- **traced scopes** — the function defs that jax will trace: functions
+  decorated with ``@jax.jit`` / ``@partial(jax.jit, ...)``, functions passed
+  by name into ``jax.jit(...)`` / ``jax.lax.scan`` / grad / vmap / shard_map
+  etc., everything lexically nested inside those, and (fixpoint) every
+  same-module function they call by name. Host-side code like CLI mains
+  never enters the set, so host-only idioms (``float(metrics["loss"])``)
+  don't false-positive.
+- **line suppression** — ``# orion: noqa[rule-id]`` (or several ids,
+  comma-separated) on the finding's line suppresses it; a bare
+  ``# orion: noqa`` suppresses every rule on that line.
+
+``lint_source`` checks one in-memory module (what the unit tests use);
+``lint_paths`` walks files and applies the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from functools import cached_property
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set
+
+from orion_tpu.analysis.findings import (
+    BaselineEntry,
+    Finding,
+    apply_baseline,
+    normalize_path,
+)
+
+NOQA_RE = re.compile(r"#\s*orion:\s*noqa(?:\[([A-Za-z0-9_\-,\s]+)\])?")
+NOQA_ALL = frozenset({"*"})
+
+# Call targets whose function-valued arguments jax traces.
+_TRACE_WRAPPERS = {
+    "jax.jit", "jit", "pjit", "jax.pjit",
+    "jax.grad", "jax.value_and_grad", "grad", "value_and_grad",
+    "jax.vmap", "jax.pmap", "vmap", "pmap",
+    "jax.checkpoint", "jax.remat", "checkpoint", "remat",
+    "jax.lax.scan", "lax.scan", "scan",
+    "jax.lax.while_loop", "lax.while_loop", "while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop", "fori_loop",
+    "jax.lax.cond", "lax.cond", "jax.lax.switch", "lax.switch",
+    "jax.lax.associative_scan", "lax.associative_scan",
+    "shard_map", "jax.shard_map", "shard_map_bh",
+    "jax.eval_shape", "jax.make_jaxpr",
+    "jax.custom_vjp", "jax.custom_jvp",
+}
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "nn.jit"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.debug.print``-style dotted name for Name/Attribute chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this decorator/callee expression denote jax.jit (possibly via
+    ``partial(jax.jit, ...)`` or a configured ``jax.jit(...)`` call)?"""
+    name = dotted_name(node)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in _JIT_NAMES:
+            return True  # @jax.jit(static_argnums=...)
+        if fname in ("partial", "functools.partial") and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def jit_decorations(fn: ast.AST) -> List[ast.expr]:
+    return [d for d in getattr(fn, "decorator_list", []) if _is_jit_expr(d)]
+
+
+class ModuleContext:
+    """One parsed module plus the derived info rules share."""
+
+    def __init__(self, source: str, path: str = "<memory>", root: str = ""):
+        self.source = source
+        self.path = (
+            normalize_path(path, root) if path != "<memory>" else path
+        )
+        self.tree = ast.parse(source)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._orion_parent = parent  # type: ignore[attr-defined]
+
+    # -- path predicates -----------------------------------------------------
+
+    @property
+    def is_test(self) -> bool:
+        base = self.path.rsplit("/", 1)[-1]
+        return "tests/" in self.path or base.startswith("test_")
+
+    @property
+    def is_hot_path(self) -> bool:
+        """Modules where a Python-loop jnp accumulation is a perf bug, not
+        a style nit: the trainer, the decode path, and every op."""
+        p = self.path
+        return any(
+            s in p
+            for s in ("training/trainer", "generate", "/ops/", "train_lra")
+        ) or p.startswith("ops/")
+
+    @property
+    def is_pallas_module(self) -> bool:
+        return "ops/pallas/" in self.path and not self.path.endswith(
+            "__init__.py"
+        )
+
+    # -- traced-scope analysis ----------------------------------------------
+
+    @cached_property
+    def function_defs(self) -> List[ast.AST]:
+        return [
+            n
+            for n in ast.walk(self.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+    @cached_property
+    def traced_functions(self) -> Set[ast.AST]:
+        """Function defs jax will trace (see module docstring)."""
+        by_name: Dict[str, List[ast.AST]] = {}
+        for fn in self.function_defs:
+            by_name.setdefault(fn.name, []).append(fn)
+
+        roots: Set[ast.AST] = set()
+        for fn in self.function_defs:
+            if jit_decorations(fn) or any(
+                _is_trace_decorator(d) for d in fn.decorator_list
+            ):
+                roots.add(fn)
+
+        # functions passed by name (or as self.method) into a tracing call
+        referenced: Set[str] = set()
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = dotted_name(call.func)
+            if callee not in _TRACE_WRAPPERS:
+                continue
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                name = dotted_name(arg)
+                if name:
+                    referenced.add(name.rsplit(".", 1)[-1])
+        for name in referenced:
+            roots.update(by_name.get(name, []))
+
+        # close over lexical nesting and same-module direct calls
+        traced: Set[ast.AST] = set()
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            if fn in traced:
+                continue
+            traced.add(fn)
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node is not fn
+                ):
+                    frontier.append(node)
+                elif isinstance(node, ast.Call):
+                    callee = dotted_name(node.func)
+                    if callee:
+                        frontier.extend(
+                            by_name.get(callee.rsplit(".", 1)[-1], [])
+                        )
+        return traced
+
+    def in_traced_scope(self, node: ast.AST) -> bool:
+        cur = getattr(node, "_orion_parent", None)
+        while cur is not None:
+            if cur in self.traced_functions:
+                return True
+            cur = getattr(cur, "_orion_parent", None)
+        return False
+
+    # -- suppression ---------------------------------------------------------
+
+    @cached_property
+    def noqa_lines(self) -> Dict[int, FrozenSet[str]]:
+        out: Dict[int, FrozenSet[str]] = {}
+        for i, line in enumerate(self.source.splitlines(), start=1):
+            m = NOQA_RE.search(line)
+            if not m:
+                continue
+            ids = m.group(1)
+            out[i] = (
+                frozenset(s.strip() for s in ids.split(",") if s.strip())
+                if ids
+                else NOQA_ALL
+            )
+        return out
+
+    def suppressed(self, finding: Finding) -> bool:
+        ids = self.noqa_lines.get(finding.line)
+        return ids is not None and (ids is NOQA_ALL or finding.rule in ids)
+
+
+def _is_trace_decorator(node: ast.AST) -> bool:
+    name = dotted_name(node if not isinstance(node, ast.Call) else node.func)
+    return name in _TRACE_WRAPPERS
+
+
+# -- engine -------------------------------------------------------------------
+
+
+def default_rules():
+    from orion_tpu.analysis.rules import ALL_RULES
+
+    return list(ALL_RULES.values())
+
+
+def lint_source(
+    source: str,
+    path: str = "<memory>",
+    rules=None,
+    root: str = "",
+) -> List[Finding]:
+    """Lint one module's source; returns unsuppressed findings, sorted."""
+    ctx = ModuleContext(source, path, root)
+    findings: List[Finding] = []
+    for rule in rules if rules is not None else default_rules():
+        findings.extend(rule.check(ctx))
+    findings = [f for f in findings if not ctx.suppressed(f)]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__"
+                )
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules=None,
+    baseline: Sequence[BaselineEntry] = (),
+    root: str = "",
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            findings.extend(lint_source(source, path, rules=rules, root=root))
+        except SyntaxError as e:
+            # the engine must never crash on the code under audit — an
+            # unparseable file is itself a (non-suppressable) finding
+            findings.append(Finding(
+                "parse-error", normalize_path(path, root), e.lineno or 0,
+                f"file does not parse: {e.msg}",
+            ))
+    return apply_baseline(findings, baseline)
+
+
+__all__ = [
+    "ModuleContext", "dotted_name", "jit_decorations", "lint_source",
+    "lint_paths", "iter_py_files", "default_rules",
+]

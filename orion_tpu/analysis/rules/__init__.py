@@ -1,0 +1,22 @@
+"""Rule registry for the Tier A lint engine.
+
+Adding a rule: write a class with ``id``, ``title``, and
+``check(ctx: ModuleContext) -> Iterator[Finding]`` in one of the modules
+here (or a new one), append an instance to that module's ``RULES`` list, and
+import the module below. ``tests/test_analysis.py`` expects every registered
+rule to have a positive and a negative fixture.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from orion_tpu.analysis.rules import hygiene, jit_hygiene, pallas_guards, perf
+
+ALL_RULES: Dict[str, object] = {}
+for _mod in (jit_hygiene, perf, hygiene, pallas_guards):
+    for _rule in _mod.RULES:
+        assert _rule.id not in ALL_RULES, f"duplicate rule id {_rule.id}"
+        ALL_RULES[_rule.id] = _rule
+
+__all__ = ["ALL_RULES"]

@@ -1,0 +1,62 @@
+"""General Python-hygiene rules with JAX-specific failure modes.
+
+``mutable-default`` — a mutable default argument is shared across calls; in
+                      this codebase the sharper hazard is a default that
+                      later flows into a jit static arg or a config pytree,
+                      where aliasing means cross-call state leaks.
+``bare-except``     — ``except:`` swallows ``KeyboardInterrupt`` and —
+                      worse here — XLA's ``RESOURCE_EXHAUSTED`` / Mosaic
+                      compile errors that callers (e.g. the trainer's OOM
+                      remat fallback) dispatch on by type and message.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from orion_tpu.analysis.findings import Finding
+from orion_tpu.analysis.lint import ModuleContext, dotted_name
+
+
+class MutableDefaultRule:
+    id = "mutable-default"
+    title = "mutable default argument"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ctx.function_defs:
+            args = fn.args
+            for d in list(args.defaults) + [
+                kd for kd in args.kw_defaults if kd is not None
+            ]:
+                if isinstance(
+                    d,
+                    (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp),
+                ) or (
+                    isinstance(d, ast.Call)
+                    and dotted_name(d.func) in ("list", "dict", "set")
+                ):
+                    yield Finding(
+                        self.id, ctx.path, d.lineno,
+                        f"mutable default in {fn.name}(): shared across "
+                        "calls — default to None and construct inside",
+                    )
+
+
+class BareExceptRule:
+    id = "bare-except"
+    title = "bare except clause"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield Finding(
+                    self.id, ctx.path, node.lineno,
+                    "bare except: catches KeyboardInterrupt and masks XLA "
+                    "compile/OOM errors callers dispatch on — name the "
+                    "exception type",
+                )
+
+
+RULES = [MutableDefaultRule(), BareExceptRule()]

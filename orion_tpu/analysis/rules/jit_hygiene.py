@@ -1,0 +1,222 @@
+"""Rules about what may appear inside jit-traced code.
+
+``jit-debug``     — no ``print``/``jax.debug.*`` inside traced scopes: a
+                    ``print`` fires at trace time (once, with tracers), and
+                    ``jax.debug.print``/``callback`` insert host round-trips
+                    that serialize the decode loop.
+``tracer-host``   — no ``.item()``/``.tolist()``/``float()``/``int()``/
+                    ``np.asarray()`` on values inside traced scopes: these
+                    force a host-device sync (or fail outright under jit).
+``static-hashable`` — parameters named by ``static_argnums``/
+                    ``static_argnames`` must be hashable-typed; an unhashable
+                    static arg either crashes at call time or — worse, for
+                    types with identity hashing — silently recompiles on
+                    every call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from orion_tpu.analysis.findings import Finding
+from orion_tpu.analysis.lint import (
+    ModuleContext,
+    dotted_name,
+    jit_decorations,
+)
+
+_DEBUG_CALLS = {
+    "print",
+    "jax.debug.print",
+    "jax.debug.callback",
+    "jax.debug.breakpoint",
+    "debug.print",
+    "debug.callback",
+    "debug.breakpoint",
+}
+
+_HOST_NP_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array",
+}
+
+_UNHASHABLE_TYPE_NAMES = {"list", "dict", "set", "List", "Dict", "Set",
+                          "bytearray"}
+
+
+class JitDebugRule:
+    id = "jit-debug"
+    title = "print/jax.debug.* inside a traced function"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _DEBUG_CALLS and ctx.in_traced_scope(node):
+                yield Finding(
+                    self.id, ctx.path, node.lineno,
+                    f"{name}() inside a jit-traced function: trace-time "
+                    "side effect / host round-trip in the compiled path",
+                )
+
+
+class TracerHostRule:
+    id = "tracer-host"
+    title = "host materialization of a tracer"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not ctx.in_traced_scope(node):
+                continue
+            name = dotted_name(node.func)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("item", "tolist")
+                and not node.args
+            ):
+                yield Finding(
+                    self.id, ctx.path, node.lineno,
+                    f".{node.func.attr}() in a traced scope forces a "
+                    "host-device sync (ConcretizationTypeError under jit)",
+                )
+            elif name in ("float", "int", "bool") and len(node.args) == 1:
+                if not isinstance(node.args[0], ast.Constant):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"{name}() on a traced value concretizes the "
+                        "tracer; use jnp casts/astype instead",
+                    )
+            elif name in _HOST_NP_CALLS:
+                yield Finding(
+                    self.id, ctx.path, node.lineno,
+                    f"{name}() in a traced scope pulls the tracer to host "
+                    "numpy; use jnp.asarray",
+                )
+
+
+def _literal_ints(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _annotation_unhashable(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    name = dotted_name(node)
+    return bool(name) and name.rsplit(".", 1)[-1] in _UNHASHABLE_TYPE_NAMES
+
+
+def _default_unhashable(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) in ("list", "dict", "set")
+    return False
+
+
+class StaticHashableRule:
+    id = "static-hashable"
+    title = "static_argnums/static_argnames must name hashable-typed params"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ctx.function_defs:
+            for deco in jit_decorations(fn):
+                if not isinstance(deco, ast.Call):
+                    continue
+                yield from self._check_decoration(ctx, fn, deco)
+
+    def _static_params(
+        self, fn, deco: ast.Call
+    ) -> Tuple[List[ast.arg], List[Tuple[int, str]], int]:
+        """Resolve the params a jit decoration marks static; ``bad`` holds
+        (lineno, kwarg-name) for non-literal static specs."""
+        args = fn.args
+        pos: List[ast.arg] = list(args.posonlyargs) + list(args.args)
+        params: List[ast.arg] = []
+        bad: List[Tuple[int, str]] = []
+        for kw in deco.keywords:
+            if kw.arg == "static_argnums":
+                nums = _literal_ints(kw.value)
+                if nums is None:
+                    bad.append((kw.value.lineno, "static_argnums"))
+                    continue
+                for i in nums:
+                    if 0 <= i < len(pos):
+                        params.append(pos[i])
+            elif kw.arg == "static_argnames":
+                names = _literal_strs(kw.value)
+                if names is None:
+                    bad.append((kw.value.lineno, "static_argnames"))
+                    continue
+                byname = {a.arg: a for a in pos + list(args.kwonlyargs)}
+                params.extend(byname[n] for n in names if n in byname)
+        return params, bad, len(pos)
+
+    def _check_decoration(
+        self, ctx: ModuleContext, fn, deco: ast.Call
+    ) -> Iterator[Finding]:
+        params, bad, n_pos = self._static_params(fn, deco)
+        for lineno, which in bad:
+            yield Finding(
+                self.id, ctx.path, lineno,
+                f"{which} on {fn.name}() is not a literal int/str/tuple: "
+                "the static set cannot be audited (and non-literal specs "
+                "invite unhashable surprises)",
+            )
+        # map param -> default expression (positional defaults are
+        # right-aligned; kwonly defaults pair 1:1)
+        args = fn.args
+        pos = list(args.posonlyargs) + list(args.args)
+        defaults = {}
+        for a, d in zip(pos[n_pos - len(args.defaults):], args.defaults):
+            defaults[a] = d
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            defaults[a] = d
+        for p in params:
+            if _annotation_unhashable(p.annotation):
+                yield Finding(
+                    self.id, ctx.path, p.lineno,
+                    f"static param {p.arg!r} of {fn.name}() is annotated "
+                    "with an unhashable type; jit static args are hashed "
+                    "into the compilation cache key",
+                )
+            elif _default_unhashable(defaults.get(p)):
+                yield Finding(
+                    self.id, ctx.path, p.lineno,
+                    f"static param {p.arg!r} of {fn.name}() defaults to an "
+                    "unhashable value; calls without the arg will crash in "
+                    "the jit cache lookup",
+                )
+
+
+RULES = [JitDebugRule(), TracerHostRule(), StaticHashableRule()]

@@ -1,0 +1,99 @@
+"""``pallas-chunk-guard`` — every public Pallas entrypoint must handle
+indivisible shapes explicitly.
+
+Mosaic kernels tile the token axis by a chunk/block size; a shape that does
+not divide it either miscompiles (garbage in the ragged tail) or fails deep
+inside Mosaic with an error no caller can act on. The repo-wide idiom
+(ops/pallas/causal_dot.py, flash_attention.py, gmm.py) is to either pad —
+``rem = (-t) % chunk`` — or assert divisibility — ``assert m % tile_rows ==
+0`` — before the ``pl.pallas_call``. This rule enforces that every *public*
+function in ``ops/pallas/`` that (transitively, within the module) reaches a
+``pallas_call`` has a ``%`` guard somewhere on that intra-module path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from orion_tpu.analysis.findings import Finding
+from orion_tpu.analysis.lint import ModuleContext, dotted_name
+
+
+def _module_functions(ctx: ModuleContext) -> Dict[str, ast.AST]:
+    """Module-level (top-of-file) function defs by name."""
+    return {
+        n.name: n
+        for n in ctx.tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _calls_pallas(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name.rsplit(".", 1)[-1] == "pallas_call":
+                return True
+    return False
+
+
+def _has_mod_guard(fn: ast.AST) -> bool:
+    """A ``%`` expression (padding arithmetic or a divisibility assert) or
+    an explicit check helper call anywhere in the function body."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            if "divis" in leaf or leaf.startswith("check_"):
+                return True
+    return False
+
+
+def _callees(fn: ast.AST, fns: Dict[str, ast.AST]) -> List[ast.AST]:
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name in fns:
+                out.append(fns[name])
+    return out
+
+
+class PallasChunkGuardRule:
+    id = "pallas-chunk-guard"
+    title = "public pallas entrypoint without a chunk-divisibility guard"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.is_pallas_module:
+            return
+        fns = _module_functions(ctx)
+
+        def reach(fn: ast.AST, seen: Set[int]):
+            """All module fns on fn's intra-module call graph, incl. fn."""
+            if id(fn) in seen:
+                return
+            seen.add(id(fn))
+            yield fn
+            for g in _callees(fn, fns):
+                yield from reach(g, seen)
+
+        for name, fn in fns.items():
+            if name.startswith("_"):
+                continue
+            reachable = list(reach(fn, set()))
+            if not any(_calls_pallas(g) for g in reachable):
+                continue
+            if not any(_has_mod_guard(g) for g in reachable):
+                yield Finding(
+                    self.id, ctx.path, fn.lineno,
+                    f"{name}() reaches a pallas_call with no "
+                    "chunk/block-divisibility guard or padding on the path "
+                    "— pad with `(-t) % chunk` or assert divisibility "
+                    "before launching the kernel",
+                )
+
+
+RULES = [PallasChunkGuardRule()]

@@ -1,0 +1,131 @@
+"""Performance-contract rules.
+
+``loop-accum``      — no Python-loop jnp accumulation in hot paths (trainer /
+                      generate / ops): a ``for`` that grows or re-binds an
+                      array with jnp calls unrolls into O(steps) HLO — the
+                      recompile-per-length, no-fusion anti-pattern the scan
+                      forms exist to avoid.
+``float64-literal`` — no float64 dtypes outside tests: TPUs have no f64
+                      units (everything silently demotes or dies), and on
+                      CPU parity paths a stray f64 doubles memory and hides
+                      bf16 numerics bugs the tests exist to catch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from orion_tpu.analysis.findings import Finding
+from orion_tpu.analysis.lint import ModuleContext, dotted_name
+
+_JNP_PREFIXES = ("jnp.", "jax.numpy.")
+_CONCAT_CALLS = {
+    "jnp.concatenate", "jnp.append", "jnp.stack", "jnp.vstack",
+    "jnp.hstack", "jax.numpy.concatenate", "jax.numpy.append",
+    "jax.numpy.stack",
+}
+_F64_ATTRS = {
+    "jnp.float64", "np.float64", "numpy.float64", "jax.numpy.float64",
+}
+
+
+def _contains_jnp_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted_name(sub.func)
+            if name and name.startswith(_JNP_PREFIXES):
+                return True
+    return False
+
+
+def _names_in(node: ast.AST):
+    return {
+        sub.id for sub in ast.walk(node) if isinstance(sub, ast.Name)
+    }
+
+
+class LoopAccumRule:
+    id = "loop-accum"
+    title = "Python-loop jnp accumulation in a hot path"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.is_hot_path or ctx.is_test:
+            return
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if isinstance(node, ast.AugAssign) and _contains_jnp_call(
+                    node.value
+                ):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno,
+                        "jnp accumulation via augmented assignment inside a "
+                        "Python loop: unrolled O(steps) HLO — use "
+                        "jax.lax.scan / fori_loop",
+                    )
+                elif isinstance(node, ast.Assign):
+                    if len(node.targets) != 1 or not isinstance(
+                        node.targets[0], ast.Name
+                    ):
+                        continue
+                    target = node.targets[0].id
+                    if not isinstance(node.value, ast.Call):
+                        continue
+                    if (
+                        dotted_name(node.value.func) in _CONCAT_CALLS
+                        and target in _names_in(node.value)
+                    ):
+                        yield Finding(
+                            self.id, ctx.path, node.lineno,
+                            f"growing {target!r} with "
+                            f"{dotted_name(node.value.func)} inside a "
+                            "Python loop: O(steps^2) copies and O(steps) "
+                            "HLO — carry a preallocated buffer through "
+                            "lax.scan instead",
+                        )
+
+
+class Float64Rule:
+    id = "float64-literal"
+    title = "float64 dtype outside tests"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.is_test:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                name = dotted_name(node)
+                if name in _F64_ATTRS:
+                    yield Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"{name}: TPUs have no f64 — this silently demotes "
+                        "or doubles memory on parity paths; use float32",
+                    )
+            # the comparison constant below is this rule's own probe, not a
+            # dtype use — the one legitimate in-repo suppression
+            elif (
+                isinstance(node, ast.Constant)
+                and node.value == "float64"  # orion: noqa[float64-literal]
+            ):
+                yield Finding(
+                    self.id, ctx.path, node.lineno,
+                    "'float64' dtype string outside tests; use 'float32'",
+                )
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if (
+                    name in ("jax.config.update", "config.update")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == "jax_enable_x64"
+                ):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno,
+                        "jax_enable_x64 flips global default dtypes — "
+                        "never in library code",
+                    )
+
+
+RULES = [LoopAccumRule(), Float64Rule()]

@@ -276,7 +276,14 @@ def load_params(ckpt_dir: str, step: Optional[int] = None) -> Tuple[Any, int]:
     step = mngr.latest_step() if step is None else step
     if step is None:
         raise FileNotFoundError(f"no checkpoint in {ckpt_dir}")
-    restored = mngr.restore(step)
+    try:
+        restored = mngr.restore(step)
+    except KeyError:
+        # orbax versions that saved via StandardSave refuse a bare
+        # restore(step) ("provide a CheckpointHandlerRegistry or
+        # CheckpointArgs"); StandardRestore with no target restores the
+        # saved tree structure as-is
+        restored = mngr.restore(step, args=ocp.args.StandardRestore())
     mngr.close()
     params = restored["params"]
     return params, step

@@ -127,6 +127,23 @@ class ModelConfig:
         return lt
 
 
+# Source scopes whose fp32 matmuls are SANCTIONED under the bf16 compute
+# policy — the declared exceptions the jaxpr contract auditor
+# (orion_tpu/analysis/jaxpr_audit.py::audit_matmul_bf16) checks the traced
+# train step against. Entries are 'file.py' or 'file.py::function', matched
+# against each dot_general's source frames. Everything here is the fp32
+# (S, z) kv-state accumulation contract: linear attention keeps its running
+# state in fp32 regardless of the activation dtype (the chunked scan, the
+# pallas state carries, the sp exclusive-prefix exchange, and the FAVOR+
+# feature map's numerically-sensitive projection).
+F32_MATMUL_SCOPES = (
+    "linear_attention.py",          # chunked-scan fp32 state accumulation
+    "causal_dot.py",                # pallas state init/carry helpers
+    "sequence.py",                  # sp exclusive-prefix fp32 state math
+    "transformer.py::_phi_map",     # FAVOR+ fp32 random-feature projection
+)
+
+
 TINY = ModelConfig(
     name="tiny",
     vocab_size=256,  # byte-level
@@ -272,4 +289,7 @@ def get_config(name: str, **overrides) -> ModelConfig:
     return dataclasses.replace(cfg, **overrides) if overrides else cfg
 
 
-__all__ = ["ModelConfig", "CONFIGS", "get_config", "hybrid_pattern"]
+__all__ = [
+    "ModelConfig", "CONFIGS", "get_config", "hybrid_pattern",
+    "F32_MATMUL_SCOPES",
+]

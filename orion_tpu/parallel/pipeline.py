@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from orion_tpu.utils.compat import pvary, shard_map
 
 Array = jax.Array
 
@@ -187,10 +187,7 @@ def pipeline_apply(
         # the scan carry is device-varying (each stage holds different
         # activations); mark the replicated initializers/input accordingly
         # so shard_map's varying-mesh-axes check can verify the body
-        if hasattr(lax, "pcast"):
-            micro = lax.pcast(micro, (axis,), to="varying")
-        else:  # older jax spelling
-            micro = lax.pvary(micro, (axis,))
+        micro = pvary(micro, (axis,))
 
         n_steps = n_micro + pp - 1
         zeros = jnp.zeros_like(micro[0])
@@ -199,10 +196,7 @@ def pipeline_apply(
         aux_axes = (axis,) + tuple(extra_manual_axes)
         if full_manual:
             aux_axes = aux_axes + ("dp", "fsdp")
-        if hasattr(lax, "pcast"):
-            aux0 = lax.pcast(aux0, aux_axes, to="varying")
-        else:
-            aux0 = lax.pvary(aux0, aux_axes)
+        aux0 = pvary(aux0, aux_axes)
 
         def step(carry, s):
             buf, outs, aux_tot = carry

@@ -22,7 +22,6 @@ import dataclasses
 import os
 import sys
 import time
-from functools import partial
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -183,6 +182,62 @@ def make_lra_dataset(cfg: LRATrainConfig, split: str = "train"):
     )
 
 
+def make_lra_step(model: LRAClassifier, tx, sched, root, dropout: float = 0.0):
+    """Build the (un-jitted) LRA train/eval step bodies.
+
+    Module-level so the jaxpr contract auditor
+    (orion_tpu/analysis/jaxpr_audit.py) can trace the exact step
+    ``train_lra`` runs — on abstract shapes, without a dataset or training
+    loop. ``train_lra`` jits the returned functions."""
+
+    def loss_fn(params, toks, labels, mask, rng):
+        kwargs = (
+            {"rngs": {"dropout": rng}, "deterministic": False}
+            if dropout > 0.0
+            else {}
+        )
+        logits, variables = model.apply(
+            params, toks, mask, mutable="losses", **kwargs
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        # MoE aux losses (models/moe.py), pre-weighted; empty for dense
+        for leaf in jax.tree.leaves(variables.get("losses", {})):
+            loss = loss + leaf
+        acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        return loss, acc.mean()
+
+    def step_fn(state, toks, labels, mask):
+        rng = rngs.at_step(rngs.stream(root, "dropout"), state["step"])
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], toks, labels, mask, rng
+        )
+        gnorm = optax.global_norm(grads)
+        finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+        safe = jax.tree.map(lambda g: jnp.where(finite, g, 0.0), grads)
+        updates, opt = tx.update(safe, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        sel = lambda n, o: jax.tree.map(  # noqa: E731
+            lambda a, b: jnp.where(finite, a, b), n, o
+        )
+        new_state = {
+            "params": sel(params, state["params"]),
+            "opt": sel(opt, state["opt"]),
+            "step": state["step"] + 1,
+        }
+        return new_state, {
+            "loss": loss, "acc": acc, "grad_norm": gnorm,
+            "lr": sched(state["step"]), "nonfinite": (~finite).astype(jnp.int32),
+        }
+
+    def eval_fn(params, toks, labels, mask):
+        logits = model.apply(params, toks, mask)
+        return (jnp.argmax(logits, -1) == labels).astype(jnp.float32).mean()
+
+    return step_fn, eval_fn
+
+
 def train_lra(cfg: LRATrainConfig, logger: Optional[MetricsLogger] = None):
     mesh = make_mesh(cfg.mesh)
     model = LRAClassifier(cfg.model)
@@ -216,51 +271,11 @@ def train_lra(cfg: LRATrainConfig, logger: Optional[MetricsLogger] = None):
     state = jax.jit(init_fn, out_shardings=shardings)(rngs.stream(root, "init"))
     bshard = batch_sharding(mesh)
 
-    def loss_fn(params, toks, labels, mask, rng):
-        use_drop = cfg.model.dropout > 0.0
-        kwargs = (
-            {"rngs": {"dropout": rng}, "deterministic": False} if use_drop else {}
-        )
-        logits, variables = model.apply(
-            params, toks, mask, mutable="losses", **kwargs
-        )
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, labels
-        ).mean()
-        # MoE aux losses (models/moe.py), pre-weighted; empty for dense
-        for leaf in jax.tree.leaves(variables.get("losses", {})):
-            loss = loss + leaf
-        acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
-        return loss, acc.mean()
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def step_fn(state, toks, labels, mask):
-        rng = rngs.at_step(rngs.stream(root, "dropout"), state["step"])
-        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], toks, labels, mask, rng
-        )
-        gnorm = optax.global_norm(grads)
-        finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
-        safe = jax.tree.map(lambda g: jnp.where(finite, g, 0.0), grads)
-        updates, opt = tx.update(safe, state["opt"], state["params"])
-        params = optax.apply_updates(state["params"], updates)
-        sel = lambda n, o: jax.tree.map(  # noqa: E731
-            lambda a, b: jnp.where(finite, a, b), n, o
-        )
-        new_state = {
-            "params": sel(params, state["params"]),
-            "opt": sel(opt, state["opt"]),
-            "step": state["step"] + 1,
-        }
-        return new_state, {
-            "loss": loss, "acc": acc, "grad_norm": gnorm,
-            "lr": sched(state["step"]), "nonfinite": (~finite).astype(jnp.int32),
-        }
-
-    @jax.jit
-    def eval_fn(params, toks, labels, mask):
-        logits = model.apply(params, toks, mask)
-        return (jnp.argmax(logits, -1) == labels).astype(jnp.float32).mean()
+    step_body, eval_body = make_lra_step(
+        model, tx, sched, root, cfg.model.dropout
+    )
+    step_fn = jax.jit(step_body, donate_argnums=(0,))
+    eval_fn = jax.jit(eval_body)
 
     def put(x):
         return jax.device_put(x, bshard) if x.ndim >= 1 else x

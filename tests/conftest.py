@@ -86,6 +86,35 @@ _SLOW = {
     "test_quant.py::test_quant_greedy_token_equality_trained",
     "test_quant.py::test_quant_prequantized_reuse",
     "test_quant.py::test_quant_cast_params_noop",
+    # regenerated after the jax-compat repair (utils/compat.py): these used
+    # to fail in milliseconds on the shard_map/pvary/axis_size imports and
+    # now run to completion; all measured >=10s on this box
+    "test_training.py::test_eval_factory_batches_deterministic_per_step",
+    "test_fused_adafactor.py::test_trainer_fused_matches_optax_adafactor",
+    "test_training.py::test_fused_clip_matches_optax_chain",
+    "test_moe.py::TestMoEMLP::test_dropless_decode_matches_parallel_argmax",
+    "test_quant.py::test_int4_decode_quality_bar",
+    "test_fused_ce.py::test_lm_loss_fused_sp_matches_unfused[2]",
+    "test_fused_ce.py::test_lm_loss_fused_matches_unfused",
+    "test_sharding.py::test_trainer_parity_across_meshes[f4t2]",
+    "test_fused_ce.py::test_lm_loss_fused_sp_matches_unfused[1]",
+    "test_training.py::test_bf16_sr_storage_layout_and_convergence",
+    "test_training.py::test_bf16_sr_resume_bitwise",
+    "test_moe.py::test_moe_grad_accumulation_parity[exact_no_aux]",
+    "test_fused_ce.py::test_lm_loss_fused_sp_prime_local_T",
+    "test_lra.py::test_shipped_lra_sample_end_to_end[listops-lra_listops_linear]",
+    "test_moe.py::TestGmm::test_dropless_gmm_matches_ragged_path",
+    "test_moe.py::test_moe_grad_accumulation_parity[stat_default]",
+    "test_moe.py::TestMoEMLP::test_dropless_ep_trainer_step_parity",
+    "test_lra.py::test_shipped_lra_sample_end_to_end[text-lra_text_linear]",
+    "test_training.py::test_evaluate_cli_roundtrip",
+    "test_training.py::test_train_cli_sharded_corpus_bf16_sr",
+    "test_moe.py::TestMoEMLP::test_dropless_ep_grads_match_single_host",
+    "test_generate.py::test_generate_cli_from_checkpoint",
+    "test_moe.py::TestMoETraining::test_pp_moe_microbatched_trains",
+    "test_fused_ce.py::test_model_token_losses_padded_path_parity",
+    "test_quant.py::test_quant_moe_forward_close",
+    "test_training.py::test_overfit_fixed_batch",
 }
 
 

@@ -1,0 +1,528 @@
+"""Tier-1 gate for the static-analysis suite (orion_tpu/analysis/).
+
+Every Tier A lint rule is exercised with a positive (seeded violation) and a
+negative (clean idiom) fixture; every Tier B jaxpr contract with a deliberate
+toy violation and a clean counterpart — assertions are on rule ids, never
+message text. The repo itself must come out clean: the CLI exiting 0 on the
+tree at merge is an acceptance criterion, so `test_repo_*_clean` failing
+means a real regression (or a finding that needs an in-line noqa / baseline
+entry with a rationale).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.analysis import jaxpr_audit
+from orion_tpu.analysis.findings import (
+    BaselineEntry,
+    apply_baseline,
+    load_baseline,
+)
+from orion_tpu.analysis.lint import lint_source
+from orion_tpu.analysis.rules import ALL_RULES
+
+pytestmark = pytest.mark.analysis
+
+
+def rule_ids(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# Tier A: one positive + one negative fixture per rule
+# ---------------------------------------------------------------------------
+
+# (rule-id, virtual path, bad source, clean source)
+RULE_CASES = [
+    (
+        "jit-debug",
+        "orion_tpu/dummy.py",
+        """
+import jax
+
+@jax.jit
+def f(x):
+    print("tracing", x)
+    return x
+""",
+        """
+import jax
+
+@jax.jit
+def f(x):
+    return x
+
+def host_log(x):
+    print("host side is fine", x)
+""",
+    ),
+    (
+        "jit-debug",
+        "orion_tpu/dummy.py",
+        """
+import jax
+
+@jax.jit
+def f(x):
+    jax.debug.print("x={}", x)
+    return x
+""",
+        """
+import jax
+
+def f(x):
+    jax.debug.print("not jitted, allowed", x)
+    return x
+""",
+    ),
+    (
+        "tracer-host",
+        "orion_tpu/dummy.py",
+        """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    a = x.item()
+    b = float(x)
+    c = np.asarray(x)
+    return a + b + c.sum()
+""",
+        """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    return x.astype(jnp.float32) + float(1.5)
+
+def host(x):
+    return float(x)  # untraced host code may concretize
+""",
+    ),
+    (
+        "static-hashable",
+        "orion_tpu/dummy.py",
+        """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(1,))
+def f(x, opts: list):
+    return x
+
+@partial(jax.jit, static_argnames=("cfg",))
+def g(x, cfg={}):
+    return x
+""",
+        """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(1, 2))
+def f(x, n: int, name: str = "a"):
+    return x
+""",
+    ),
+    (
+        "loop-accum",
+        "orion_tpu/generate.py",  # hot path
+        """
+import jax.numpy as jnp
+
+def decode_all(xs):
+    out = jnp.zeros((0, 4))
+    total = 0.0
+    for x in xs:
+        out = jnp.concatenate([out, x])
+        total += jnp.sum(x)
+    return out, total
+""",
+        """
+import jax
+import jax.numpy as jnp
+
+def decode_all(xs):
+    def body(carry, x):
+        return carry + jnp.sum(x), x
+    total, out = jax.lax.scan(body, 0.0, xs)
+    return out, total
+""",
+    ),
+    (
+        "float64-literal",
+        "orion_tpu/dummy.py",
+        """
+import jax.numpy as jnp
+
+def f(x):
+    return x.astype(jnp.float64) + jnp.asarray(1.0, dtype="float64")
+""",
+        """
+import jax.numpy as jnp
+
+def f(x):
+    return x.astype(jnp.float32)
+""",
+    ),
+    (
+        "mutable-default",
+        "orion_tpu/dummy.py",
+        """
+def f(x, acc=[], table={}):
+    return x
+""",
+        """
+def f(x, acc=None, table=()):
+    return x
+""",
+    ),
+    (
+        "bare-except",
+        "orion_tpu/dummy.py",
+        """
+def f(x):
+    try:
+        return x
+    except:
+        return None
+""",
+        """
+def f(x):
+    try:
+        return x
+    except ValueError:
+        return None
+""",
+    ),
+    (
+        "pallas-chunk-guard",
+        "orion_tpu/ops/pallas/dummy.py",
+        """
+import jax.experimental.pallas as pl
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def entry(x, chunk):
+    return pl.pallas_call(_kernel, out_shape=x)(x)
+""",
+        """
+import jax.experimental.pallas as pl
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def entry(x, chunk):
+    assert x.shape[-2] % chunk == 0, (x.shape, chunk)
+    return pl.pallas_call(_kernel, out_shape=x)(x)
+
+def padded_entry(x, chunk):
+    import jax.numpy as jnp
+    rem = (-x.shape[-2]) % chunk
+    x = jnp.pad(x, ((0, 0), (0, rem), (0, 0)))
+    return pl.pallas_call(_kernel, out_shape=x)(x)
+""",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "rule,path,bad,clean",
+    RULE_CASES,
+    ids=[f"{c[0]}-{i}" for i, c in enumerate(RULE_CASES)],
+)
+def test_rule_positive_and_negative(rule, path, bad, clean):
+    assert rule in rule_ids(lint_source(bad, path=path))
+    assert rule not in rule_ids(lint_source(clean, path=path))
+
+
+def test_every_registered_rule_has_a_fixture():
+    covered = {c[0] for c in RULE_CASES}
+    assert covered == set(ALL_RULES), (
+        "every rule in the registry needs a positive+negative fixture here"
+    )
+    assert len(ALL_RULES) >= 8
+
+
+def test_loop_accum_only_fires_on_hot_paths():
+    src = """
+import jax.numpy as jnp
+
+def helper(xs):
+    out = jnp.zeros((0,))
+    for x in xs:
+        out = jnp.concatenate([out, x])
+    return out
+"""
+    assert "loop-accum" in rule_ids(
+        lint_source(src, path="orion_tpu/ops/feature_maps.py")
+    )
+    # cold paths (data prep, CLIs) may build arrays in Python loops
+    assert "loop-accum" not in rule_ids(
+        lint_source(src, path="orion_tpu/prepare_data.py")
+    )
+
+
+# -- suppression / baseline ---------------------------------------------------
+
+
+def test_noqa_suppresses_specific_rule():
+    src = """
+def f(x):
+    try:
+        return x
+    except:  # orion: noqa[bare-except]
+        return None
+"""
+    assert "bare-except" not in rule_ids(lint_source(src, path="orion_tpu/d.py"))
+
+
+def test_noqa_bare_suppresses_all_and_wrong_id_does_not():
+    bare = """
+def f(x, acc=[]):  # orion: noqa
+    return acc
+"""
+    assert lint_source(bare, path="orion_tpu/d.py") == []
+    wrong = """
+def f(x, acc=[]):  # orion: noqa[bare-except]
+    return acc
+"""
+    assert "mutable-default" in rule_ids(lint_source(wrong, path="orion_tpu/d.py"))
+
+
+def test_baseline_filters_by_rule_and_path(tmp_path):
+    src = """
+def f(x, acc=[]):
+    return acc
+"""
+    findings = lint_source(src, path="orion_tpu/d.py")
+    assert findings
+    base = [BaselineEntry("mutable-default", "orion_tpu/d.py", "fixture")]
+    assert apply_baseline(findings, base) == []
+    other = [BaselineEntry("mutable-default", "orion_tpu/other.py", "fixture")]
+    assert apply_baseline(findings, other) == findings
+
+
+def test_baseline_requires_reason(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(
+        {"entries": [{"rule": "bare-except", "path": "x.py", "reason": ""}]}
+    ))
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(str(p))
+
+
+# ---------------------------------------------------------------------------
+# Tier B: jaxpr contracts — seeded violations vs clean toys
+# ---------------------------------------------------------------------------
+
+
+def test_collective_in_decode_flagged():
+    jx = jax.make_jaxpr(
+        lambda x: jax.lax.psum(x, "i"), axis_env=[("i", 2)]
+    )(jnp.ones((4,)))
+    findings = jaxpr_audit.audit_no_collectives(jx, "decode")
+    assert rule_ids(findings) == {jaxpr_audit.CONTRACT_DECODE_COLLECTIVES}
+
+
+def test_collective_free_fn_passes():
+    jx = jax.make_jaxpr(lambda x: (x * 2).sum())(jnp.ones((4,)))
+    assert jaxpr_audit.audit_no_collectives(jx, "decode") == []
+
+
+def test_f32_upcast_in_bf16_step_flagged():
+    def bad_step(a, b):
+        # the deliberate silent upcast: bf16 inputs promoted to f32 matmul
+        return jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32))
+
+    jx = jax.make_jaxpr(bad_step)(
+        jax.ShapeDtypeStruct((8, 8), jnp.bfloat16),
+        jax.ShapeDtypeStruct((8, 8), jnp.bfloat16),
+    )
+    findings = jaxpr_audit.audit_matmul_bf16(jx, "train")
+    assert rule_ids(findings) == {jaxpr_audit.CONTRACT_BF16_MATMUL}
+
+
+def test_bf16_matmul_with_f32_accum_passes():
+    def good_step(a, b):
+        return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+    jx = jax.make_jaxpr(good_step)(
+        jax.ShapeDtypeStruct((8, 8), jnp.bfloat16),
+        jax.ShapeDtypeStruct((8, 8), jnp.bfloat16),
+    )
+    assert jaxpr_audit.audit_matmul_bf16(jx, "train") == []
+
+
+def test_f32_matmul_in_declared_scope_passes():
+    def state_accum(a, b):  # stands in for the fp32 kv-state contract
+        return jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32))
+
+    jx = jax.make_jaxpr(state_accum)(
+        jax.ShapeDtypeStruct((8, 8), jnp.bfloat16),
+        jax.ShapeDtypeStruct((8, 8), jnp.bfloat16),
+    )
+    assert jaxpr_audit.audit_matmul_bf16(
+        jx, "train", allowed_scopes=("test_analysis.py",)
+    ) == []
+
+
+def test_host_callback_flagged_and_clean_passes():
+    def bad(x):
+        jax.debug.print("x={}", x)
+        return x * 2
+
+    jx = jax.make_jaxpr(bad)(jnp.ones((4,)))
+    findings = jaxpr_audit.audit_no_host_callbacks(jx, "decode")
+    assert rule_ids(findings) == {jaxpr_audit.CONTRACT_HOST_CALLBACK}
+    jx2 = jax.make_jaxpr(lambda x: x * 2)(jnp.ones((4,)))
+    assert jaxpr_audit.audit_no_host_callbacks(jx2, "decode") == []
+
+
+def _toy_decode_jaxpr(state_rows):
+    """A decode-shaped scan whose carry is sized by ``state_rows`` — O(1)
+    iff the caller passes the same value for every sequence length."""
+
+    def fn(x):
+        def body(carry, _):
+            carry = carry.at[0].add(x.sum())
+            return carry, carry[0]
+
+        return jax.lax.scan(
+            body, jnp.zeros((state_rows, 4)), None, length=state_rows
+        )
+
+    return jax.make_jaxpr(fn)(jnp.ones((4,)))
+
+
+def test_growing_decode_state_flagged():
+    findings = jaxpr_audit.audit_scan_state_invariance(
+        [("n=4", _toy_decode_jaxpr(4)), ("n=8", _toy_decode_jaxpr(8))],
+        "decode",
+    )
+    assert rule_ids(findings) == {jaxpr_audit.CONTRACT_DECODE_STATE}
+
+
+def test_o1_decode_state_passes():
+    def make(n):
+        def fn(x):
+            def body(carry, _):
+                return carry * 0.5 + x.sum(), carry.sum()
+
+            return jax.lax.scan(body, jnp.zeros((4, 4)), None, length=n)
+
+        return jax.make_jaxpr(fn)(jnp.ones((4,)))
+
+    assert jaxpr_audit.audit_scan_state_invariance(
+        [("n=4", make(4)), ("n=8", make(8))], "decode"
+    ) == []
+
+
+def test_scanless_decode_is_itself_a_finding():
+    jx = jax.make_jaxpr(lambda x: x * 2)(jnp.ones((4,)))
+    findings = jaxpr_audit.audit_scan_state_invariance([("n=4", jx)], "decode")
+    assert rule_ids(findings) == {jaxpr_audit.CONTRACT_DECODE_STATE}
+
+
+# -- the real repo entrypoints are the negative cases ------------------------
+
+
+@pytest.fixture(scope="module")
+def decode_jaxprs():
+    return (
+        jaxpr_audit.trace_decode(8, 8),
+        jaxpr_audit.trace_decode(16, 16),
+    )
+
+
+def test_repo_decode_contracts(decode_jaxprs):
+    small, large = decode_jaxprs
+    assert jaxpr_audit.audit_no_collectives(small, "decode") == []
+    assert jaxpr_audit.audit_no_host_callbacks(small, "decode") == []
+    assert jaxpr_audit.audit_scan_state_invariance(
+        [("small", small), ("large", large)], "decode"
+    ) == []
+
+
+def test_repo_train_step_bf16_policy():
+    jx = jaxpr_audit.trace_train_step()
+    from orion_tpu.models.configs import F32_MATMUL_SCOPES
+
+    assert jaxpr_audit.audit_matmul_bf16(
+        jx, "train", allowed_scopes=F32_MATMUL_SCOPES
+    ) == []
+    assert jaxpr_audit.audit_no_host_callbacks(jx, "train") == []
+    # the declared-exception list is load-bearing: with it emptied, the
+    # fp32 kv-state matmuls MUST be flagged (proves the auditor sees them)
+    undeclared = jaxpr_audit.audit_matmul_bf16(jx, "train", allowed_scopes=())
+    assert rule_ids(undeclared) == {jaxpr_audit.CONTRACT_BF16_MATMUL}
+
+
+def test_repo_lra_step_traces_clean():
+    jx = jaxpr_audit.trace_lra_step()
+    assert jaxpr_audit.audit_no_host_callbacks(jx, "lra") == []
+
+
+# ---------------------------------------------------------------------------
+# The gate itself: repo clean, CLI exit codes
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lint_clean():
+    import orion_tpu
+
+    from orion_tpu.analysis.lint import lint_paths
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(orion_tpu.__file__)))
+    findings = lint_paths(
+        [os.path.dirname(os.path.abspath(orion_tpu.__file__))],
+        baseline=load_baseline(),
+        root=root,
+    )
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_repo_jaxpr_audit_clean():
+    findings = jaxpr_audit.audit_repo()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_cli_exits_zero_on_clean_and_nonzero_on_finding(tmp_path):
+    from orion_tpu.analysis.__main__ import main
+
+    clean = tmp_path / "orion_clean.py"
+    clean.write_text("def f(x):\n    return x\n")
+    assert main([str(clean), "--tier", "lint"]) == 0
+
+    bad = tmp_path / "orion_bad.py"
+    bad.write_text("def f(x, acc=[]):\n    return acc\n")
+    assert main([str(bad), "--tier", "lint"]) == 1
+
+
+def test_cli_list_rules():
+    from orion_tpu.analysis.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+
+
+@pytest.mark.slow
+def test_cli_subprocess_whole_repo_exits_zero():
+    import orion_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(orion_tpu.__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "orion_tpu.analysis"],
+        cwd=root, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
