@@ -11,10 +11,16 @@ from __future__ import annotations
 
 from typing import Dict
 
-from orion_tpu.analysis.rules import hygiene, jit_hygiene, pallas_guards, perf
+from orion_tpu.analysis.rules import (
+    concurrency,
+    hygiene,
+    jit_hygiene,
+    pallas_guards,
+    perf,
+)
 
 ALL_RULES: Dict[str, object] = {}
-for _mod in (jit_hygiene, perf, hygiene, pallas_guards):
+for _mod in (jit_hygiene, perf, hygiene, pallas_guards, concurrency):
     for _rule in _mod.RULES:
         assert _rule.id not in ALL_RULES, f"duplicate rule id {_rule.id}"
         ALL_RULES[_rule.id] = _rule

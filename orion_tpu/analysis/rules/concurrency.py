@@ -1,0 +1,62 @@
+"""Concurrency-hygiene rules born from the resilience work.
+
+``unbounded-wait`` — a ``queue.get()`` / ``Thread.join()`` with no timeout
+                     blocks forever when the peer thread is dead or hung:
+                     exactly the failure the watchdog/stall machinery
+                     (resilience/watchdog.py) exists to convert into a
+                     diagnosable ``StallError``. The data-loader hang this
+                     rule encodes was real: a died prefetch worker left
+                     ``__next__`` polling a queue that could never fill.
+
+Heuristics (AST-only, no type info): a zero-argument ``.get()`` (or one
+whose only kwarg is ``block``) can't be ``dict.get`` — that requires a key —
+so it is a blocking queue read; a ``.join()`` with no arguments at all can't
+be ``str.join``/``os.path.join`` — both require operands — so it is a
+thread/process join. Calls carrying a ``timeout=`` kwarg pass. Test code is
+exempt (tests may legitimately block on a result); real exceptions use the
+standard ``# orion: noqa[unbounded-wait]`` / baseline escape hatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from orion_tpu.analysis.findings import Finding
+from orion_tpu.analysis.lint import ModuleContext
+
+
+class UnboundedWaitRule:
+    id = "unbounded-wait"
+    title = "unbounded blocking wait (no timeout)"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.is_test:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            meth = node.func.attr
+            if meth not in ("get", "join"):
+                continue
+            if node.args:
+                continue  # dict.get(key), "sep".join(parts), path.join(...)
+            kws = {k.arg for k in node.keywords}
+            if "timeout" in kws:
+                continue
+            if meth == "get" and kws - {"block"}:
+                continue  # keyword'd non-queue .get()
+            if meth == "join" and kws:
+                continue
+            yield Finding(
+                self.id, ctx.path, node.lineno,
+                f".{meth}() with no timeout blocks forever if the peer "
+                "thread is dead or hung — pass timeout= and surface a "
+                "StallError (resilience/watchdog.py), or suppress with "
+                "# orion: noqa[unbounded-wait]",
+            )
+
+
+RULES = [UnboundedWaitRule()]

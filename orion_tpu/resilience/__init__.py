@@ -1,0 +1,36 @@
+"""Resilience subsystem: fault injection, retry, watchdogs, preemption.
+
+Production TPU jobs live with preemption, flaky storage, and silent
+checkpoint corruption. This package holds the host-side machinery that
+makes the trainer's determinism guarantees (batches as pure functions of
+``(seed, step)``, bitwise resume) survive real faults — and the harness
+that proves it by injecting them:
+
+- :mod:`inject`   — deterministic, test-controlled fault delivery at named
+  production hook points (checkpoint/data I/O errors, NaN gradient
+  poisoning, simulated preemption) plus checkpoint corruption helpers.
+- :mod:`retry`    — jittered exponential backoff for transient I/O, with
+  injectable sleep/rng so tests run in milliseconds.
+- :mod:`watchdog` — heartbeat stall detection (:class:`StallError`) for
+  hung device steps and stalled data loaders, with an injectable clock.
+- :mod:`preempt`  — SIGTERM/SIGINT -> graceful stop at the next step
+  boundary, emergency checkpoint, resumable exit.
+
+Import direction: this package depends only on the stdlib (+numpy at the
+edges); ``training/`` imports it, never the reverse.
+"""
+
+# NOTE: `inject` stays bound to the SUBMODULE (inject.inject/fire/nan_armed
+# are used as inject.<fn>); re-exporting the functions here would shadow it
+from orion_tpu.resilience import inject
+from orion_tpu.resilience.inject import FaultPlan
+from orion_tpu.resilience.preempt import PreemptionGuard
+from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
+from orion_tpu.resilience.watchdog import StallError, Watchdog
+
+__all__ = [
+    "inject", "FaultPlan",
+    "PreemptionGuard",
+    "RetryPolicy", "call_with_retries",
+    "StallError", "Watchdog",
+]

@@ -1,0 +1,209 @@
+"""Deterministic fault injection: test-controlled failures at named
+production hook points.
+
+The production code carries permanent, near-zero-cost hooks — a
+``fire(site, step=...)`` call at each faultable operation — that are inert
+until a test arms a :class:`FaultPlan` via the :func:`inject` context
+manager. Faults are addressed by ``(site, step, occurrence count)``, so a
+chaos test can say "the checkpoint write at step 2 fails twice, then
+succeeds" and get exactly that, every run.
+
+Hook sites wired today:
+
+========================  ====================================================
+``"ckpt.save"``           training/checkpoint.py, inside the retry region
+``"ckpt.restore"``        training/checkpoint.py, inside the retry region
+``"data.batch"``          training/data.py prefetch worker, inside the retry
+                          region
+``"train.step_boundary"`` trainer loop, after bookkeeping for each step —
+                          where :meth:`FaultPlan.preempt_at` delivers a real
+                          SIGTERM (the installed PreemptionGuard then drives
+                          the graceful-stop path end to end)
+``"train.nan"``           consumed via :func:`nan_armed` by ``Trainer.step``
+                          to poison one step's gradients to NaN
+========================  ====================================================
+
+Also here: :func:`corrupt_step` / :func:`truncate_step`, which damage a
+written orbax step directory on disk the way flaky storage does — the
+integrity-verified restore path (training/checkpoint.py) is tested against
+both.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import signal
+import threading
+from typing import Callable, List, Optional
+
+_NAN_SITE = "train.nan"
+
+
+@dataclasses.dataclass
+class _Fault:
+    site: str
+    step: Optional[int]  # None = any step
+    times: int  # remaining deliveries; <0 = unlimited
+    action: Optional[Callable[[], None]]  # None = marker (consumed via query)
+
+
+class FaultPlan:
+    """An ordered set of faults to deliver. Thread-safe: the data-loader
+    worker and the main thread both fire hooks."""
+
+    def __init__(self):
+        self._faults: List[_Fault] = []
+        self._lock = threading.Lock()
+        self.delivered: List[str] = []  # "(site, step)" log for assertions
+
+    # -- authoring -----------------------------------------------------------
+
+    def add(
+        self,
+        site: str,
+        step: Optional[int] = None,
+        times: int = 1,
+        action: Optional[Callable[[], None]] = None,
+    ) -> "FaultPlan":
+        self._faults.append(_Fault(site, step, times, action))
+        return self
+
+    def fail_io(
+        self,
+        site: str,
+        step: Optional[int] = None,
+        times: int = 1,
+        exc: type = OSError,
+        msg: str = "injected I/O fault",
+    ) -> "FaultPlan":
+        """Raise ``exc`` from the hook — the retry layer sees a transient
+        storage error exactly where a real one would surface."""
+
+        def raise_():
+            raise exc(f"{msg} [site={site}]")
+
+        return self.add(site, step, times, raise_)
+
+    def preempt_at(self, step: int, sig: int = signal.SIGTERM) -> "FaultPlan":
+        """Deliver a real OS signal at the given step's boundary. With a
+        PreemptionGuard installed this exercises the whole graceful-stop
+        path: handler -> stop request -> emergency checkpoint -> resumable
+        exit."""
+        return self.add(
+            "train.step_boundary", step, 1, lambda: signal.raise_signal(sig)
+        )
+
+    def poison_nan_at(self, step: int) -> "FaultPlan":
+        """Arm a NaN-gradient poisoning for one training step (consumed by
+        ``Trainer.step`` via :func:`nan_armed`)."""
+        return self.add(_NAN_SITE, step, 1, None)
+
+    # -- delivery ------------------------------------------------------------
+
+    def _take(self, site: str, step: Optional[int]) -> Optional[_Fault]:
+        with self._lock:
+            for f in self._faults:
+                if f.site != site or f.times == 0:
+                    continue
+                if f.step is not None and step is not None and f.step != step:
+                    continue
+                if f.step is not None and step is None:
+                    continue
+                if f.times > 0:
+                    f.times -= 1
+                self.delivered.append(f"{site}@{step}")
+                return f
+        return None
+
+    def fire(self, site: str, step: Optional[int] = None) -> None:
+        f = self._take(site, step)
+        if f is not None and f.action is not None:
+            f.action()
+
+    def consume_marker(self, site: str, step: Optional[int] = None) -> bool:
+        return self._take(site, step) is not None
+
+
+_active: Optional[FaultPlan] = None
+
+
+@contextlib.contextmanager
+def inject(plan: FaultPlan):
+    """Arm ``plan`` for the duration of the block (not reentrant-safe per
+    thread, but plans themselves are thread-safe)."""
+    global _active
+    prev = _active
+    _active = plan
+    try:
+        yield plan
+    finally:
+        _active = prev
+
+
+def active() -> bool:
+    """Is any fault plan armed? Hot-path callers gate on this BEFORE
+    computing hook arguments (e.g. the trainer's step number is a device
+    scalar — reading it unconditionally would sync every step)."""
+    return _active is not None
+
+
+def fire(site: str, step: Optional[int] = None) -> None:
+    """Production hook: no-op (one global read) unless a plan is armed."""
+    plan = _active
+    if plan is not None:
+        plan.fire(site, step)
+
+
+def nan_armed(step: int) -> bool:
+    """Is a NaN-gradient poisoning armed for ``step``? Consumes it."""
+    plan = _active
+    return plan is not None and plan.consume_marker(_NAN_SITE, step)
+
+
+# -- on-disk checkpoint corruption (test control, not a hook) -----------------
+
+
+def _step_files(ckpt_dir: str, step: int) -> List[str]:
+    step_dir = os.path.join(ckpt_dir, str(step))
+    if not os.path.isdir(step_dir):
+        raise FileNotFoundError(f"no step directory {step_dir}")
+    out = []
+    for dirpath, _, filenames in os.walk(step_dir):
+        for f in sorted(filenames):
+            out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+def corrupt_step(ckpt_dir: str, step: int) -> List[str]:
+    """Flip bytes in the middle of every file of a written orbax step —
+    the bit-rot / torn-write failure mode. Returns the files touched."""
+    touched = []
+    for path in _step_files(ckpt_dir, step):
+        size = os.path.getsize(path)
+        if size == 0:
+            continue
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(min(64, size - size // 2))
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        touched.append(path)
+    return touched
+
+
+def truncate_step(ckpt_dir: str, step: int) -> List[str]:
+    """Truncate the step's largest file to half — the preempted-mid-write
+    failure mode (an incomplete step directory)."""
+    files = [p for p in _step_files(ckpt_dir, step) if os.path.getsize(p) > 0]
+    target = max(files, key=os.path.getsize)
+    with open(target, "r+b") as f:
+        f.truncate(os.path.getsize(target) // 2)
+    return [target]
+
+
+__all__ = [
+    "FaultPlan", "inject", "active", "fire", "nan_armed",
+    "corrupt_step", "truncate_step",
+]

@@ -16,12 +16,15 @@ CLI:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import sys
 from typing import Optional, Tuple
 
 from orion_tpu.models.configs import get_config
 from orion_tpu.parallel.mesh import MeshConfig, initialize_distributed
+from orion_tpu.resilience.preempt import PreemptionGuard
+from orion_tpu.resilience.watchdog import Watchdog
 from orion_tpu.training.checkpoint import Checkpointer
 from orion_tpu.training.data import DataLoader, make_dataset
 from orion_tpu.training.metrics import MetricsLogger
@@ -66,6 +69,7 @@ def train(
         seed=cfg.seed,
         start_step=start,
         sharding=trainer.batch_shd,
+        stall_timeout=cfg.step_timeout if cfg.step_timeout > 0 else None,
     )
     logger = MetricsLogger(log_path)
     eval_factory = None
@@ -92,6 +96,10 @@ def train(
             loader = DataLoader(
                 _ds, cfg.batch_size, seed=cfg.seed + 1, start_step=base,
                 sharding=trainer.batch_shd,
+                # eval reads get the same stall budget as train reads — a
+                # dead mount under --eval-data must raise a diagnosable
+                # StallError, not hang the (watchdog-disarmed) eval pass
+                stall_timeout=cfg.step_timeout if cfg.step_timeout > 0 else None,
             )
 
             def gen():
@@ -106,16 +114,47 @@ def train(
                     loader.close()  # safety if the consumer stops early
 
             return gen()
+    # resilience wiring (resilience/): preempt_grace > 0 installs the
+    # SIGTERM/SIGINT graceful-stop guard for the duration of the run;
+    # step_timeout > 0 arms the hang watchdog (the loader's stall detector
+    # is wired above with the same budget)
+    guard_cm = (
+        PreemptionGuard(cfg.preempt_grace)
+        if cfg.preempt_grace > 0
+        else contextlib.nullcontext()
+    )
+    watchdog = Watchdog(cfg.step_timeout) if cfg.step_timeout > 0 else None
     try:
-        last = trainer.train(
-            iter(loader), logger=logger, ckpt=ckpt, eval_factory=eval_factory
-        )
-        if ckpt is not None:
+        with guard_cm as guard:
+            last = trainer.train(
+                iter(loader), logger=logger, ckpt=ckpt,
+                eval_factory=eval_factory, preempt=guard, watchdog=watchdog,
+            )
+        if trainer.preempted_at is not None:
+            note = (
+                "emergency checkpoint saved; rerun with the same "
+                "--ckpt-dir to resume"
+                if ckpt is not None
+                else "NO checkpointer configured — progress since the last "
+                     "save is lost (set --ckpt-dir)"
+            )
+            print(
+                f"preempted at step {trainer.preempted_at}: {note}",
+                file=sys.stderr,
+            )
+        elif ckpt is not None:
             ckpt.maybe_save(int(trainer.state.step), trainer.state, force=True)
-            ckpt.wait()
     finally:
+        if watchdog is not None:
+            watchdog.close()
         loader.close()
         logger.close()
+        if ckpt is not None:
+            # close() waits for any in-flight async save, INCLUDING on the
+            # exception path — a raise mid-train must not abandon a
+            # half-written step (the manifest/fallback machinery handles
+            # torn writes, but not leaking the writer)
+            ckpt.close()
     return trainer.state, last
 
 
@@ -134,6 +173,18 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--log-path", default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--preempt-grace", type=float, default=10.0,
+        help="seconds budgeted for the emergency checkpoint on SIGTERM/"
+             "SIGINT (graceful stop at the next step boundary); 0 disables "
+             "the signal handlers",
+    )
+    p.add_argument(
+        "--step-timeout", type=float, default=0.0,
+        help="hang watchdog: raise StallError if no step completes (or no "
+             "data batch arrives) for this many seconds — must exceed jit "
+             "compile + one step; 0 disables",
+    )
     p.add_argument("--dp", type=int, default=-1)
     p.add_argument("--fsdp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
@@ -169,6 +220,8 @@ def main(argv=None) -> int:
         seed=args.seed,
         eval_every=args.eval_every,
         ckpt_dir=args.ckpt_dir,
+        preempt_grace=args.preempt_grace,
+        step_timeout=args.step_timeout,
         mesh=MeshConfig(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp,
                         pp=args.pp, ep=args.ep),
     )

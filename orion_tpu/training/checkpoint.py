@@ -1,19 +1,113 @@
 """Checkpoint/resume via orbax (SURVEY.md T4): async save, retention,
-sharded restore.
+sharded restore — hardened with integrity manifests, retried I/O, and
+corrupt-step fallback (resilience subsystem).
 
 The state saved is the whole TrainState pytree (params + optimizer state +
 step + root rng key); the data pipeline needs no state because batches are
 pure functions of (seed, step) — resume re-derives the stream from the
 restored step (training/data.py). Restoring onto a mesh passes the target
-shardings so orbax lands shards directly on their devices."""
+shardings so orbax lands shards directly on their devices.
+
+Integrity: every save also writes ``manifests/manifest-<step>.json`` next
+to the orbax step dirs — the pytree structure (key paths) plus per-leaf
+shape/dtype/crc32 of the logical array bytes. ``restore`` re-checksums what
+orbax handed back and compares; on mismatch — or on orbax failing outright
+on a truncated/corrupt step — the default-latest restore falls back to the
+newest *intact* retained step with a loud warning instead of dying
+unrecoverably. The checksum is over the logical (fully-gathered) array, so
+verification is mesh-independent: a checkpoint written on dp=1 verifies
+bit-for-bit when restored onto fsdp2/tp2. Orbax's step-dir scan ignores the
+non-numeric ``manifests/`` entry, and manifests are garbage-collected with
+retention. Save/restore I/O is wrapped in jittered-backoff retries
+(resilience/retry.py) with fault-injection hooks (resilience/inject.py)
+inside the retried region, so chaos tests drive the real paths.
+"""
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import warnings
+import zlib
+from typing import Any, Dict, List, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
+
+from orion_tpu.resilience.inject import fire
+from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
+
+MANIFEST_DIRNAME = "manifests"
+MANIFEST_VERSION = 1
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint step failed manifest verification (or has an unreadable
+    manifest): structure, shape/dtype, or content checksum mismatch."""
+
+
+def _leaf_array(leaf: Any) -> np.ndarray:
+    """Host view of a leaf's logical bytes; typed PRNG keys checksum their
+    key data (old-style uint32 keys pass through np.asarray)."""
+    if hasattr(leaf, "dtype") and jax.numpy.issubdtype(
+        leaf.dtype, jax.dtypes.prng_key
+    ):
+        leaf = jax.random.key_data(leaf)
+    return np.asarray(leaf)
+
+
+def build_manifest(state: Any, step: int) -> Dict[str, Any]:
+    """Pytree structure + per-leaf shape/dtype/crc32 for ``state``. Pulls
+    every leaf to host once — the same device->host traffic the async save
+    itself does, and the price of end-to-end content verification."""
+    leaves = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        arr = _leaf_array(leaf)
+        leaves.append({
+            "path": jax.tree_util.keystr(path),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "crc32": int(zlib.crc32(arr.tobytes())),
+        })
+    return {
+        "version": MANIFEST_VERSION,
+        "step": int(step),
+        "n_leaves": len(leaves),
+        "leaves": leaves,
+    }
+
+
+def verify_manifest(state: Any, manifest: Dict[str, Any]) -> None:
+    """Raise :class:`CheckpointIntegrityError` unless ``state`` matches the
+    manifest leaf-for-leaf (paths, shapes, dtypes, content checksums)."""
+    expected = {e["path"]: e for e in manifest.get("leaves", ())}
+    problems: List[str] = []
+    seen = set()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = jax.tree_util.keystr(path)
+        seen.add(key)
+        e = expected.get(key)
+        if e is None:
+            problems.append(f"unexpected leaf {key}")
+            continue
+        arr = _leaf_array(leaf)
+        if list(arr.shape) != e["shape"] or str(arr.dtype) != e["dtype"]:
+            problems.append(
+                f"{key}: shape/dtype {arr.shape}/{arr.dtype} != manifest "
+                f"{tuple(e['shape'])}/{e['dtype']}"
+            )
+        elif int(zlib.crc32(arr.tobytes())) != e["crc32"]:
+            problems.append(f"{key}: content checksum mismatch")
+    missing = set(expected) - seen
+    if missing:
+        problems.append(f"missing leaves: {sorted(missing)[:3]}")
+    if problems:
+        head = "; ".join(problems[:5])
+        more = f" (+{len(problems) - 5} more)" if len(problems) > 5 else ""
+        raise CheckpointIntegrityError(
+            f"step {manifest.get('step')}: {head}{more}"
+        )
 
 
 class Checkpointer:
@@ -23,9 +117,32 @@ class Checkpointer:
         max_to_keep: int = 3,
         async_save: bool = True,
         save_every: int = 1000,
+        retry: Optional[RetryPolicy] = None,
+        verify: bool = True,
     ):
         self.directory = os.path.abspath(directory)
         self.save_every = save_every
+        self._retry = retry if retry is not None else RetryPolicy()
+        # manifests checksum the LOGICAL array, which requires gathering
+        # every leaf to one host — impossible for arrays spanning
+        # non-addressable devices. Multi-process runs therefore skip the
+        # manifest (restore already warns-and-accepts manifest-less steps);
+        # per-shard manifests are future work.
+        self._verify = verify and jax.process_count() == 1
+        if verify and not self._verify:
+            warnings.warn(
+                "checkpoint integrity manifests disabled: multi-process run "
+                "(leaves span non-addressable devices)",
+                stacklevel=2,
+            )
+        self._manifest_dir = os.path.join(self.directory, MANIFEST_DIRNAME)
+        # idempotence guard: an emergency save (preemption / nan-halt) may
+        # land on a step the cadence already saved — orbax rejects step
+        # re-saves, so skip instead of crashing the shutdown path. Steps
+        # that failed restore verification are exempt: a re-save there
+        # OVERWRITES the known-bad copy rather than being skipped.
+        self._last_saved: Optional[int] = None
+        self._corrupt_steps: set = set()
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save,
@@ -36,22 +153,157 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def all_steps(self) -> List[int]:
+        return sorted(self._mngr.all_steps())
+
+    # -- save ----------------------------------------------------------------
+
     def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
+        """NOTE on async saves: the retry below covers the save DISPATCH
+        (and the whole write when async_save=False); a storage error inside
+        an in-flight async write surfaces later, un-retried, from
+        ``wait()``/``close()``. Emergency paths (preemption, nan-halt) call
+        ``wait()`` immediately after, so their failures are at least loud
+        and prompt."""
         if not force and (self.save_every <= 0 or step % self.save_every != 0):
             return False
-        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        existing = set(self._mngr.all_steps())
+        if step in existing and step in self._corrupt_steps:
+            # the on-disk copy of this step failed verification at restore
+            # time — delete it so this (good) state can take its place
+            self._mngr.delete(step)
+            self._corrupt_steps.discard(step)
+            existing.discard(step)
+        if step == self._last_saved or step in existing:
+            return False
+
+        def _save():
+            fire("ckpt.save", step=step)
+            self._mngr.save(step, args=ocp.args.StandardSave(state))
+
+        call_with_retries(
+            _save, self._retry, describe=f"checkpoint save (step {step})"
+        )
+        if self._verify:
+            self._write_manifest(step, state)
+        self._last_saved = step
         return True
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._manifest_dir, f"manifest-{step}.json")
+
+    def _write_manifest(self, step: int, state: Any) -> None:
+        manifest = build_manifest(state, step)
+
+        def _write():
+            os.makedirs(self._manifest_dir, exist_ok=True)
+            tmp = self._manifest_path(step) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+            os.replace(tmp, self._manifest_path(step))  # atomic publish
+
+        call_with_retries(
+            _write, self._retry, describe=f"checkpoint manifest (step {step})"
+        )
+        self._gc_manifests(keep_also=step)
+
+    def _gc_manifests(self, keep_also: int) -> None:
+        """Retention for manifests mirrors orbax's step retention (the
+        in-flight step isn't in all_steps yet — keep it explicitly)."""
+        keep = set(self._mngr.all_steps()) | {keep_also}
+        if not os.path.isdir(self._manifest_dir):
+            return
+        for name in os.listdir(self._manifest_dir):
+            if not (name.startswith("manifest-") and name.endswith(".json")):
+                continue
+            try:
+                step = int(name[len("manifest-"):-len(".json")])
+            except ValueError:
+                continue
+            if step not in keep:
+                try:
+                    os.remove(os.path.join(self._manifest_dir, name))
+                except OSError:
+                    pass  # GC is advisory; next save retries
+
+    # -- restore -------------------------------------------------------------
 
     def restore(self, abstract_state: Any, step: Optional[int] = None) -> Any:
         """Restore at ``step`` (default latest) into the sharding/dtype layout
         described by ``abstract_state`` (jax.ShapeDtypeStruct tree with
-        shardings attached)."""
-        step = self.latest_step if step is None else step
-        if step is None:
+        shardings attached).
+
+        Default-latest restores verify against the step's manifest and fall
+        back to the newest INTACT retained step (loud warning) when the
+        latest is corrupt or incomplete. An explicitly requested step never
+        falls back — the caller pinned it, so corruption there raises."""
+        if step is not None:
+            return self._restore_step(step, abstract_state)
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
-        return self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state)
+        failures: List[tuple] = []
+        for s in steps:
+            try:
+                state = self._restore_step(s, abstract_state)
+            except Exception as e:  # orbax corruption surfaces as many types
+                failures.append((s, e))
+                self._corrupt_steps.add(s)  # a later save may overwrite it
+                warnings.warn(
+                    f"checkpoint step {s} is corrupt or incomplete "
+                    f"({type(e).__name__}: {str(e)[:200]}); falling back to "
+                    "the next retained step",
+                    stacklevel=2,
+                )
+                continue
+            if failures:
+                warnings.warn(
+                    f"restored step {s} after skipping corrupt step(s) "
+                    f"{[f[0] for f in failures]} — up to "
+                    f"{steps[0] - s} step(s) of progress lost",
+                    stacklevel=2,
+                )
+            return state
+        raise CheckpointIntegrityError(
+            f"no intact checkpoint in {self.directory}; tried "
+            + ", ".join(f"{s} ({type(e).__name__})" for s, e in failures)
+        ) from failures[-1][1]
+
+    def _restore_step(self, step: int, abstract_state: Any) -> Any:
+        def _restore():
+            fire("ckpt.restore", step=step)
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state)
+            )
+
+        state = call_with_retries(
+            _restore, self._retry, describe=f"checkpoint restore (step {step})"
         )
+        if self._verify:
+            manifest = self._read_manifest(step)
+            if manifest is None:
+                warnings.warn(
+                    f"checkpoint step {step} has no integrity manifest "
+                    "(pre-manifest checkpoint?); restoring unverified",
+                    stacklevel=2,
+                )
+            else:
+                verify_manifest(state, manifest)
+        return state
+
+    def _read_manifest(self, step: int) -> Optional[Dict[str, Any]]:
+        path = self._manifest_path(step)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointIntegrityError(
+                f"step {step}: manifest unreadable ({e})"
+            ) from e
+
+    # -- lifecycle -----------------------------------------------------------
 
     def wait(self):
         self._mngr.wait_until_finished()
@@ -71,4 +323,7 @@ def abstract_like(state: Any) -> Any:
     return jax.tree.map(leaf, state)
 
 
-__all__ = ["Checkpointer", "abstract_like"]
+__all__ = [
+    "Checkpointer", "CheckpointIntegrityError", "abstract_like",
+    "build_manifest", "verify_manifest",
+]

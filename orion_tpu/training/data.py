@@ -19,10 +19,15 @@ import json
 import os
 import queue
 import threading
+import time
 from typing import Iterator, Optional
 
 import jax
 import numpy as np
+
+from orion_tpu.resilience.inject import fire
+from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
+from orion_tpu.resilience.watchdog import StallError
 
 Array = jax.Array
 
@@ -185,7 +190,15 @@ class SyntheticDataset:
 class DataLoader:
     """Background-thread prefetch: dataset.batch → device_put with the batch
     sharding, ``prefetch`` batches deep. Restart-safe: construction takes the
-    starting step, and batches are pure functions of (seed, step)."""
+    starting step, and batches are pure functions of (seed, step).
+
+    Resilience: transient ``OSError`` from the dataset read retries with
+    jittered backoff (``retry``); a worker that dies anyway re-raises its
+    ORIGINAL exception (traceback intact, as ``__cause__``) from
+    ``__next__``; and with ``stall_timeout`` set, a consumer that waits
+    longer than that for a batch gets a diagnosable
+    :class:`~orion_tpu.resilience.watchdog.StallError` instead of blocking
+    forever on a hung read (dead NFS mount, wedged native loader)."""
 
     def __init__(
         self,
@@ -195,22 +208,46 @@ class DataLoader:
         start_step: int = 0,
         sharding=None,
         prefetch: int = 2,
+        stall_timeout: Optional[float] = None,
+        retry: Optional[RetryPolicy] = None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
         self.seed = seed
         self.step = start_step
         self.sharding = sharding
+        self.stall_timeout = stall_timeout
+        self._retry = (
+            retry
+            if retry is not None
+            else RetryPolicy(attempts=3, base_delay=0.05, max_delay=1.0)
+        )
         self._q: queue.Queue = queue.Queue(maxsize=prefetch)
         self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._fetch_step = start_step  # what the worker is on (diagnosis)
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
     def _worker(self):
+        try:
+            self._worker_loop()
+        except BaseException as e:  # kept for __next__ to chain, tb intact
+            self._exc = e
+
+    def _worker_loop(self):
         step = self.step
         multihost = jax.process_count() > 1
         while not self._stop.is_set():
-            host = self.dataset.batch(self.seed, step, self.batch_size)
+            self._fetch_step = step
+
+            def fetch(step=step):
+                fire("data.batch", step=step)
+                return self.dataset.batch(self.seed, step, self.batch_size)
+
+            host = call_with_retries(
+                fetch, self._retry, describe=f"data batch fetch (step {step})"
+            )
             if self.sharding is not None and multihost:
                 # multi-host: a plain device_put of globally-sharded data
                 # would need non-addressable devices. Sampling is a pure
@@ -238,12 +275,30 @@ class DataLoader:
         return self
 
     def __next__(self) -> Array:
+        deadline = (
+            time.monotonic() + self.stall_timeout
+            if self.stall_timeout
+            else None
+        )
         while True:
+            wait = 1.0
+            if deadline is not None:
+                wait = max(0.02, min(1.0, deadline - time.monotonic()))
             try:
-                return self._q.get(timeout=1.0)
+                return self._q.get(timeout=wait)
             except queue.Empty:
-                if not self._thread.is_alive():
-                    raise RuntimeError("data prefetch thread died")
+                if self._exc is not None or not self._thread.is_alive():
+                    raise RuntimeError(
+                        "data prefetch thread died at step "
+                        f"{self._fetch_step}"
+                    ) from self._exc
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise StallError(
+                        "data loader stalled: no batch for "
+                        f"{self.stall_timeout:.1f}s (prefetch worker alive "
+                        f"but stuck fetching step {self._fetch_step} — "
+                        "hung dataset read?)"
+                    )
 
     def close(self):
         self._stop.set()

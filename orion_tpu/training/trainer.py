@@ -35,6 +35,7 @@ from orion_tpu.models.configs import ModelConfig
 from orion_tpu.models.transformer import TransformerLM, _dtype
 from orion_tpu.parallel.mesh import MeshConfig, make_mesh
 from orion_tpu.parallel.sharding import batch_sharding, param_shardings
+from orion_tpu.resilience import inject as _inject
 from orion_tpu.utils import rng as rngs
 
 Array = jax.Array
@@ -92,6 +93,15 @@ class TrainConfig:
     ckpt_every: int = 1000
     ckpt_keep: int = 3
     nan_policy: str = "skip"  # "skip" | "halt"
+    # resilience (resilience/): preempt_grace > 0 installs SIGTERM/SIGINT
+    # handlers around train() — first signal = graceful stop at the next
+    # step boundary + emergency checkpoint, second = die now; the value is
+    # the seconds budgeted for that emergency save. step_timeout > 0 arms
+    # a hang watchdog AND the data-loader stall detector: no step heartbeat
+    # (or no batch) for that long raises StallError instead of hanging.
+    # Must comfortably exceed jit compile + one step, not just one step.
+    preempt_grace: float = 10.0
+    step_timeout: float = 0.0
 
     @property
     def micro_batch(self) -> int:
@@ -520,6 +530,8 @@ class Trainer:
             self._eval_step, in_shardings=(self.state_shardings.params, self.batch_shd)
         )
         self.nonfinite_steps = 0
+        # step at which a graceful preemption stopped train(), else None
+        self.preempted_at: Optional[int] = None
 
     # -- jitted bodies ------------------------------------------------------
 
@@ -701,6 +713,24 @@ class Trainer:
             "Trainer was built with materialize=False (AOT planning only); "
             "no state to train"
         )
+        # chaos harness (resilience/inject.py): a NaN-poisoned step. One
+        # leaf goes NaN -> non-finite loss/grads -> the device-side guard
+        # skips the update tree-wide, so after the step params == the
+        # pre-step values we stash here (copies: _step_fn donates its
+        # input buffers). Net effect is exactly a transient NaN-grad step:
+        # step+1, nonfinite+1, params/opt state unchanged.
+        keep = None
+        # gate on active() FIRST: int(state.step) reads a device scalar
+        # (output of the previous jitted step), and an unconditional read
+        # would host-sync every step — exactly the serialization the log-
+        # cadence metric reads avoid
+        if _inject.active() and _inject.nan_armed(int(self.state.step) + 1):
+            keep = jax.tree.map(jnp.copy, self.state.params)
+            flat, tree = jax.tree.flatten(self.state.params)
+            flat[0] = jnp.full_like(flat[0], jnp.nan)
+            self.state = self.state.replace(
+                params=jax.tree.unflatten(tree, flat)
+            )
         try:
             self.state, metrics = self._step_fn(self.state, batch)
         except Exception as e:
@@ -744,23 +774,35 @@ class Trainer:
                 in_shardings=(self.state_shardings.params, self.batch_shd),
             )
             self.state, metrics = self._step_fn(self.state, batch)
+        if keep is not None:
+            # the skipped update propagated the poisoned leaf as "old
+            # value"; swap the clean pre-step params back in
+            self.state = self.state.replace(params=keep)
         return metrics
 
     def train(
         self, data_iter, logger=None, ckpt=None, hook=None, eval_iter=None,
-        eval_factory=None,
+        eval_factory=None, preempt=None, watchdog=None,
     ) -> Dict[str, float]:
         """Run cfg.steps - state.step steps. Returns last metrics (host).
         ``eval_iter`` + cfg.eval_every > 0 interleaves held-out evals.
         ``eval_factory(step) -> iterator`` makes each eval's batches a pure
         function of the TRAIN step (resume-deterministic — a long-lived
         eval_iter's position depends on how many evals this process has
-        already run, so a resumed run re-samples different batches)."""
+        already run, so a resumed run re-samples different batches).
+
+        ``preempt`` (resilience/preempt.py PreemptionGuard): when its
+        ``should_stop`` flips, stop at the step boundary, force an
+        emergency checkpoint, and return with ``self.preempted_at`` set —
+        the run resumes from exactly this step. ``watchdog``
+        (resilience/watchdog.py) gets one heartbeat per step."""
         cfg = self.cfg
         tokens_per_step = cfg.batch_size * cfg.seq_len
         last: Dict[str, float] = {}
         start_step = int(self.state.step)
         for step in range(start_step + 1, cfg.steps + 1):
+            if watchdog is not None:
+                watchdog.beat(f"train step {step}")
             batch = next(data_iter)
             metrics = self.step(batch)
             # only materialize metrics on the host at log cadence — reading a
@@ -772,8 +814,20 @@ class Trainer:
                 if nf_total > self.nonfinite_steps:
                     self.nonfinite_steps = nf_total
                     if cfg.nan_policy == "halt":
+                        # emergency checkpoint BEFORE halting: the offending
+                        # state must be post-mortem restorable (params are
+                        # the pre-skip values, counter included)
+                        if watchdog is not None:
+                            watchdog.disarm()  # don't escalate vs the save
+                        if ckpt is not None:
+                            ckpt.maybe_save(step, self.state, force=True)
+                            ckpt.wait()
                         raise FloatingPointError(
                             f"{nf_total} non-finite step(s) by step {step}"
+                            + (
+                                f"; emergency checkpoint saved at step {step}"
+                                if ckpt is not None else ""
+                            )
                         )
                 last = {k: float(v) for k, v in metrics.items()}
                 last["ppl"] = float(jnp.exp(jnp.minimum(last["loss"], 20.0)))
@@ -784,16 +838,46 @@ class Trainer:
                 and cfg.eval_every
                 and (step % cfg.eval_every == 0 or step == cfg.steps)
             ):
+                if watchdog is not None:
+                    # an eval pass (first one includes its jit compile) may
+                    # legitimately exceed one step's budget — suspend stall
+                    # detection across it rather than misread it as a hang;
+                    # a hung EVAL DATA read is still caught by the eval
+                    # loader's own stall_timeout (train.py)
+                    watchdog.disarm()
                 ev = self.evaluate(
                     eval_factory(step) if eval_factory is not None else eval_iter
                 )
                 last.update(ev)
                 if logger:
                     logger.log(step, ev)
+                if watchdog is not None:
+                    watchdog.arm(f"train step {step} (post-eval)")
             if ckpt is not None:
                 ckpt.maybe_save(step, self.state)
             if hook is not None:
                 hook(step, metrics)
+            # chaos harness: simulated preemption delivers a real signal
+            # here; the installed guard's handler runs synchronously and
+            # flips should_stop before the check below
+            _inject.fire("train.step_boundary", step=step)
+            if preempt is not None and preempt.should_stop:
+                # graceful stop at the step boundary (the only place the
+                # state is consistent): emergency checkpoint, then return
+                # resumable — maybe_save is idempotent per step, so a
+                # cadence save this same step isn't re-written
+                if watchdog is not None:
+                    # the save may take longer than one step budget; the
+                    # watchdog must not escalate against the very save its
+                    # stall action triggered
+                    watchdog.disarm()
+                if ckpt is not None:
+                    ckpt.maybe_save(step, self.state, force=True)
+                    ckpt.wait()
+                self.preempted_at = step
+                if not last:
+                    last = {k: float(v) for k, v in metrics.items()}
+                break
         if not last and start_step < cfg.steps:
             last = {k: float(v) for k, v in metrics.items()}
         return last

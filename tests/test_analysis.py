@@ -204,6 +204,36 @@ def f(x):
 """,
     ),
     (
+        "unbounded-wait",
+        "orion_tpu/dummy.py",
+        """
+import queue
+import threading
+
+_q = queue.Queue()
+
+def consume(worker: threading.Thread):
+    item = _q.get()
+    also = _q.get(block=True)
+    worker.join()
+    return item, also
+""",
+        """
+import queue
+import threading
+
+_q = queue.Queue()
+
+def consume(worker: threading.Thread, opts: dict):
+    item = _q.get(timeout=5.0)
+    worker.join(timeout=2.0)
+    name = opts.get("name")        # dict.get needs a key: not a wait
+    path = "/".join(["a", "b"])    # str.join needs operands: not a wait
+    fast = _q.get_nowait()
+    return item, name, path, fast
+""",
+    ),
+    (
         "pallas-chunk-guard",
         "orion_tpu/ops/pallas/dummy.py",
         """
@@ -251,6 +281,25 @@ def test_every_registered_rule_has_a_fixture():
         "every rule in the registry needs a positive+negative fixture here"
     )
     assert len(ALL_RULES) >= 8
+
+
+def test_unbounded_wait_exempts_tests():
+    src = """
+import queue
+
+_q = queue.Queue()
+
+def poll(worker):
+    worker.join()
+    return _q.get()
+"""
+    # tests may legitimately block on a result
+    assert "unbounded-wait" not in rule_ids(
+        lint_source(src, path="tests/test_dummy.py")
+    )
+    assert "unbounded-wait" in rule_ids(
+        lint_source(src, path="orion_tpu/training/dummy.py")
+    )
 
 
 def test_loop_accum_only_fires_on_hot_paths():

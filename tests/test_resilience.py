@@ -1,0 +1,536 @@
+"""Chaos suite (ISSUE 2): injected faults driven through the REAL train()
+path — simulated preemption with bitwise-identical resume, checkpoint
+corruption with fallback restore, NaN-step poisoning (skip and halt),
+transient-I/O retry, and stall detection — plus fake-clock unit tests for
+the retry/watchdog/preemption primitives themselves."""
+
+import os
+import shutil
+import signal
+import threading
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.models.configs import ModelConfig
+from orion_tpu.parallel.mesh import MeshConfig
+from orion_tpu.resilience import inject
+from orion_tpu.resilience.preempt import PreemptionGuard
+from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
+from orion_tpu.resilience.watchdog import StallError, Watchdog
+from orion_tpu.train import train as train_fn
+from orion_tpu.training.checkpoint import (
+    Checkpointer,
+    CheckpointIntegrityError,
+    build_manifest,
+    verify_manifest,
+)
+from orion_tpu.training.data import DataLoader, SyntheticDataset
+from orion_tpu.training.trainer import TrainConfig, Trainer
+
+pytestmark = pytest.mark.chaos
+
+TINY = ModelConfig(
+    name="chaos_tiny", vocab_size=32, d_model=16, n_layers=1, n_heads=2,
+    max_seq_len=32, dtype="float32", backend="xla",
+)
+
+FAST_RETRY = RetryPolicy(attempts=4, base_delay=0.01, max_delay=0.05)
+
+
+def tiny_cfg(ckpt_dir=None, **kw) -> TrainConfig:
+    base = dict(
+        model=TINY, steps=6, batch_size=2, seq_len=16, lr=1e-3,
+        warmup_steps=2, log_every=1, mesh=MeshConfig(dp=1),
+        ckpt_dir=ckpt_dir, ckpt_every=2, preempt_grace=30.0,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def params_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ),
+        a, b,
+    )
+
+
+# ---------------------------------------------------------------------------
+# primitives: retry / watchdog / preemption guard / fault plans
+# ---------------------------------------------------------------------------
+
+
+def test_retry_backoff_delays_and_success():
+    delays, calls = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = call_with_retries(
+            flaky, RetryPolicy(attempts=4, base_delay=0.1, max_delay=5.0,
+                               jitter=0.5),
+            sleep=delays.append, describe="unit",
+        )
+    assert out == "ok" and len(calls) == 3
+    # delay i in [base*2^i, base*2^i * 1.5] — jitter only stretches
+    assert len(delays) == 2
+    assert 0.1 <= delays[0] <= 0.15 and 0.2 <= delays[1] <= 0.3
+    # deterministic: same describe -> same jitter sequence
+    calls2, delays2 = [], []
+
+    def flaky2():
+        calls2.append(1)
+        if len(calls2) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        call_with_retries(
+            flaky2, RetryPolicy(attempts=4, base_delay=0.1, max_delay=5.0,
+                                jitter=0.5),
+            sleep=delays2.append, describe="unit",
+        )
+    assert delays == delays2
+
+
+def test_retry_nonretryable_and_exhaustion():
+    # corruption-shaped errors must NOT be retried
+    calls = []
+
+    def corrupt():
+        calls.append(1)
+        raise ValueError("bad bytes")
+
+    with pytest.raises(ValueError):
+        call_with_retries(corrupt, FAST_RETRY, sleep=lambda d: None)
+    assert len(calls) == 1
+    # budget spent -> the last transient error propagates
+    calls2 = []
+
+    def always():
+        calls2.append(1)
+        raise OSError("still down")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(OSError, match="still down"):
+            call_with_retries(always, FAST_RETRY, sleep=lambda d: None)
+    assert len(calls2) == FAST_RETRY.attempts
+
+
+def test_watchdog_manual_fake_clock():
+    now = [0.0]
+    wd = Watchdog(timeout=5.0, clock=lambda: now[0], monitor=False,
+                  label="step")
+    wd.beat()
+    now[0] = 4.0
+    wd.check()  # within budget
+    wd.beat()
+    now[0] = 10.0  # 6s since last beat
+    with pytest.raises(StallError, match="no heartbeat"):
+        wd.check()
+    wd.beat()  # beat re-arms after a trip
+    now[0] = 11.0
+    wd.check()
+    wd.disarm()
+    now[0] = 100.0
+    wd.check()  # disarmed: silent
+    wd.close()
+
+
+def test_watchdog_monitor_thread_invokes_on_stall():
+    stalled = threading.Event()
+    diags = []
+
+    def on_stall(d):
+        diags.append(d)
+        stalled.set()
+
+    wd = Watchdog(timeout=0.15, on_stall=on_stall, monitor=True,
+                  poll_interval=0.02, label="device step")
+    try:
+        wd.beat()
+        assert stalled.wait(timeout=3.0), "monitor thread never fired"
+        assert "device step" in diags[0] and wd.last_stall == diags[0]
+        n = len(diags)
+        time.sleep(0.04)  # well inside the escalation window (one timeout)
+        assert len(diags) == n, "on_stall must fire once per trip, not poll"
+    finally:
+        wd.close()
+
+
+def test_watchdog_escalates_while_stall_persists():
+    """One trip per timeout-window of continued silence — a stall that the
+    graceful path can't clear keeps escalating (the built-in handler aborts
+    at attempt 3) instead of being absorbed once and hanging forever."""
+    fired = []
+    wd = Watchdog(timeout=0.12, on_stall=fired.append, monitor=True,
+                  poll_interval=0.02, label="wedged step")
+    try:
+        wd.beat()
+        deadline = time.monotonic() + 5.0
+        while len(fired) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(fired) >= 2, "stall persisted but never escalated"
+        assert "attempt 1" in fired[0] and "attempt 2" in fired[1]
+        assert wd.trip_attempt >= 2
+        wd.beat()  # recovery resets the escalation counter
+        assert wd.trip_attempt == 0
+    finally:
+        wd.close()
+
+
+def test_preemption_guard_graceful_then_hard():
+    with PreemptionGuard(grace=30.0) as guard:
+        assert not guard.should_stop
+        signal.raise_signal(signal.SIGTERM)  # handler runs synchronously
+        assert guard.should_stop and guard.signum == signal.SIGTERM
+        assert 0.0 < guard.remaining_grace() <= 30.0
+    # handlers restored on exit
+    assert signal.getsignal(signal.SIGTERM) is not guard._handle
+
+    # second signal = the operator insists: original disposition re-raised
+    with PreemptionGuard(grace=30.0) as guard:
+        signal.raise_signal(signal.SIGINT)
+        assert guard.should_stop
+        with pytest.raises(KeyboardInterrupt):
+            signal.raise_signal(signal.SIGINT)
+
+
+def test_fault_plan_addressing():
+    inject.fire("ckpt.save", step=1)  # no plan armed: inert
+
+    plan = inject.FaultPlan().fail_io("ckpt.save", step=2, times=2)
+    plan.poison_nan_at(3)
+    with inject.inject(plan):
+        inject.fire("ckpt.save", step=1)  # wrong step: no delivery
+        with pytest.raises(OSError):
+            inject.fire("ckpt.save", step=2)
+        with pytest.raises(OSError):
+            inject.fire("ckpt.save", step=2)
+        inject.fire("ckpt.save", step=2)  # times=2 exhausted
+        inject.fire("ckpt.restore", step=2)  # different site: no delivery
+        assert not inject.nan_armed(2)
+        assert inject.nan_armed(3)
+        assert not inject.nan_armed(3)  # consumed
+    inject.fire("ckpt.save", step=2)  # disarmed on exit
+    assert plan.delivered == ["ckpt.save@2", "ckpt.save@2", "train.nan@3"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: manifest round-trip, tamper detection
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_roundtrip_and_tamper_detection():
+    state = {
+        "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+        "b": jnp.ones((4,), jnp.bfloat16),
+        "rng": jax.random.PRNGKey(0),
+        "step": jnp.asarray(7, jnp.int32),
+    }
+    m = build_manifest(state, step=7)
+    assert m["n_leaves"] == len(jax.tree.leaves(state))
+    verify_manifest(state, m)  # clean round-trip
+
+    flipped = dict(state, w=state["w"].at[1, 2].set(99.0))
+    with pytest.raises(CheckpointIntegrityError, match="checksum"):
+        verify_manifest(flipped, m)
+
+    reshaped = dict(state, b=jnp.ones((5,), jnp.bfloat16))
+    with pytest.raises(CheckpointIntegrityError, match="shape/dtype"):
+        verify_manifest(reshaped, m)
+
+    missing = {k: v for k, v in state.items() if k != "b"}
+    with pytest.raises(CheckpointIntegrityError, match="missing"):
+        verify_manifest(missing, m)
+
+
+def test_checkpoint_save_retries_injected_io_and_is_idempotent(tmp_path):
+    cfg = tiny_cfg(str(tmp_path / "ck"), steps=2)
+    trainer = Trainer(cfg)
+    ck = Checkpointer(cfg.ckpt_dir, save_every=10_000, async_save=False,
+                      retry=FAST_RETRY)
+    plan = inject.FaultPlan().fail_io("ckpt.save", times=2)
+    with inject.inject(plan):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert ck.maybe_save(1, trainer.state, force=True)
+    assert sum("retrying" in str(x.message) for x in w) == 2
+    # idempotence: an emergency re-save of an already-saved step is a no-op
+    assert not ck.maybe_save(1, trainer.state, force=True)
+    # the retried save is intact: restore verifies against its manifest
+    restored = ck.restore(trainer.abstract_state(), step=1)
+    params_equal(restored.params, trainer.state.params)
+    ck.close()
+
+
+@pytest.fixture(scope="module")
+def trained_ckpt(tmp_path_factory):
+    """One 4-step run with saves at steps 2 and 4 (+ manifests), reused by
+    the corruption tests via copytree."""
+    d = str(tmp_path_factory.mktemp("base") / "ck")
+    cfg = tiny_cfg(d, steps=4, ckpt_every=2)
+    state, _ = train_fn(cfg, data="synthetic", resume=False)
+    return cfg, jax.tree.map(np.asarray, state.params)
+
+
+@pytest.mark.parametrize("damage", ["corrupt", "truncate"])
+def test_restore_falls_back_to_newest_intact_step(
+    trained_ckpt, tmp_path, damage
+):
+    cfg0, _ = trained_ckpt
+    d = str(tmp_path / "ck")
+    shutil.copytree(cfg0.ckpt_dir, d)
+    damage_fn = inject.corrupt_step if damage == "corrupt" else inject.truncate_step
+    assert damage_fn(d, 4)
+
+    cfg = tiny_cfg(d, steps=4, ckpt_every=2)
+    trainer = Trainer(cfg)
+    ck = Checkpointer(d, save_every=10_000, async_save=False)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        start = trainer.restore(ck)
+    assert start == 2, "must fall back to the newest INTACT step"
+    msgs = " | ".join(str(x.message) for x in w)
+    assert "corrupt or incomplete" in msgs and "skipping corrupt step" in msgs
+    # training continues from the fallback step
+    ds = SyntheticDataset(cfg.model.vocab_size, cfg.seq_len)
+
+    def batches(step=start):
+        while True:
+            yield jnp.asarray(ds.batch(cfg.seed, step, cfg.batch_size))
+            step += 1
+
+    last = trainer.train(batches())
+    assert np.isfinite(last["loss"])
+    ck.close()
+
+
+def test_resave_overwrites_step_that_failed_verification(
+    trained_ckpt, tmp_path
+):
+    """After a fallback restore, re-reaching the corrupt step must OVERWRITE
+    the known-bad copy, not be skipped by the idempotence guard — otherwise
+    the 'emergency checkpoint saved' message would lie."""
+    cfg0, _ = trained_ckpt
+    d = str(tmp_path / "ck")
+    shutil.copytree(cfg0.ckpt_dir, d)
+    inject.corrupt_step(d, 4)
+
+    cfg = tiny_cfg(d, steps=4, ckpt_every=2)
+    trainer = Trainer(cfg)
+    ck = Checkpointer(d, save_every=10_000, async_save=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        start = trainer.restore(ck)
+    assert start == 2
+
+    def batches(step=start):
+        ds = SyntheticDataset(cfg.model.vocab_size, cfg.seq_len)
+        while True:
+            yield jnp.asarray(ds.batch(cfg.seed, step, cfg.batch_size))
+            step += 1
+
+    trainer.train(batches())  # back at step 4
+    assert ck.maybe_save(4, trainer.state, force=True), (
+        "the known-bad step 4 must be overwritten, not skipped"
+    )
+    restored = ck.restore(trainer.abstract_state(), step=4)  # verifies
+    params_equal(restored.params, trainer.state.params)
+    ck.close()
+
+
+def test_explicitly_pinned_step_never_falls_back(trained_ckpt, tmp_path):
+    cfg0, _ = trained_ckpt
+    d = str(tmp_path / "ck")
+    shutil.copytree(cfg0.ckpt_dir, d)
+    inject.corrupt_step(d, 4)
+    trainer = Trainer(tiny_cfg(d, steps=4, ckpt_every=2))
+    ck = Checkpointer(d, save_every=10_000, async_save=False)
+    with pytest.raises(Exception):  # the caller pinned step 4: no fallback
+        ck.restore(trainer.abstract_state(), step=4)
+    ck.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos through the real train() path
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_crash_resume_bitwise(tmp_path):
+    """SIGTERM delivered mid-run (step 3, NOT a cadence step) -> graceful
+    stop + emergency checkpoint -> resumed run lands bitwise-identical to
+    an uninterrupted one (the A3 guarantee surviving a real fault)."""
+    cfg_a = tiny_cfg(str(tmp_path / "a"), steps=6, ckpt_every=2)
+    state_a, _ = train_fn(cfg_a, data="synthetic", resume=False)
+
+    cfg_b = tiny_cfg(str(tmp_path / "b"), steps=6, ckpt_every=2)
+    plan = inject.FaultPlan().preempt_at(3)
+    with inject.inject(plan):
+        state_b, _ = train_fn(cfg_b, data="synthetic", resume=False)
+    assert plan.delivered == ["train.step_boundary@3"]
+    assert int(state_b.step) == 3, "stopped at the preempted step boundary"
+    # the emergency save is off-cadence (3 % ckpt_every != 0): its presence
+    # proves the preemption path wrote it
+    assert os.path.isdir(os.path.join(cfg_b.ckpt_dir, "3"))
+
+    state_b2, _ = train_fn(cfg_b, data="synthetic", resume=True)
+    assert int(state_b2.step) == 6
+    params_equal(state_a.params, state_b2.params)
+    params_equal(state_a.opt_state, state_b2.opt_state)
+
+
+def test_nan_poison_skip_policy_continues(tmp_path):
+    cfg = tiny_cfg(str(tmp_path / "ck"), steps=4, ckpt_every=100)
+    plan = inject.FaultPlan().poison_nan_at(2)
+    with inject.inject(plan):
+        state, last = train_fn(cfg, data="synthetic", resume=False)
+    assert plan.delivered == ["train.nan@2"]
+    assert int(state.step) == 4 and int(state.nonfinite) == 1
+    assert np.isfinite(last["loss"])
+    assert jax.tree.all(
+        jax.tree.map(lambda p: bool(jnp.isfinite(p).all()), state.params)
+    ), "the poisoned step must not leak NaN into params"
+
+
+def test_nan_poison_halt_saves_emergency_checkpoint(tmp_path):
+    """nan_policy='halt' force-saves the offending state before raising, so
+    the failure is post-mortem restorable (previously it just died)."""
+    cfg = tiny_cfg(
+        str(tmp_path / "ck"), steps=4, ckpt_every=100, nan_policy="halt"
+    )
+    plan = inject.FaultPlan().poison_nan_at(2)
+    with inject.inject(plan):
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            train_fn(cfg, data="synthetic", resume=False)
+    # ckpt_every=100: the ONLY save possible is the emergency one
+    ck = Checkpointer(cfg.ckpt_dir, save_every=10_000, async_save=False)
+    assert ck.latest_step == 2
+    trainer = Trainer(cfg)
+    start = trainer.restore(ck)
+    assert start == 2 and int(trainer.state.nonfinite) == 1
+    ck.close()
+
+
+def test_ckpt_io_retry_through_train(tmp_path):
+    """A checkpoint save that fails transiently twice still lands, and the
+    run's final state restores verified."""
+    cfg = tiny_cfg(str(tmp_path / "ck"), steps=2, ckpt_every=2)
+    plan = inject.FaultPlan().fail_io("ckpt.save", step=2, times=2)
+    # train() builds its own Checkpointer (default RetryPolicy: real but
+    # sub-second backoff for 2 retries)
+    with inject.inject(plan):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            state, _ = train_fn(cfg, data="synthetic", resume=False)
+    assert sum("retrying" in str(x.message) for x in w) == 2
+    trainer = Trainer(cfg)
+    ck = Checkpointer(cfg.ckpt_dir, save_every=10_000, async_save=False)
+    restored = ck.restore(trainer.abstract_state())
+    params_equal(restored.params, state.params)
+    ck.close()
+
+
+# ---------------------------------------------------------------------------
+# data loader: retry, worker-death chaining, stall detection
+# ---------------------------------------------------------------------------
+
+
+def test_dataloader_retries_transient_io():
+    ds = SyntheticDataset(32, 8)
+    plan = inject.FaultPlan().fail_io("data.batch", step=1, times=2)
+    with inject.inject(plan):
+        loader = DataLoader(ds, batch_size=2, seed=1, start_step=0,
+                            retry=FAST_RETRY)
+        try:
+            next(loader)
+            b1 = next(loader)
+        finally:
+            loader.close()
+    # the retried batch is the SAME deterministic (seed, step) batch — the
+    # fault changed timing, never data
+    np.testing.assert_array_equal(np.asarray(b1), ds.batch(1, 1, 2))
+    assert plan.delivered == ["data.batch@1", "data.batch@1"]
+
+
+def test_dataloader_reraises_worker_exception_with_cause():
+    ds = SyntheticDataset(32, 8)
+
+    class Dies:
+        vocab_size = 32
+
+        def batch(self, seed, step, b):
+            if step >= 1:
+                raise ValueError("shard 7 unreadable")  # non-retryable
+            return ds.batch(seed, step, b)
+
+    loader = DataLoader(Dies(), batch_size=2, seed=0, start_step=0)
+    try:
+        next(loader)
+        with pytest.raises(RuntimeError, match="prefetch thread died") as ei:
+            while True:
+                next(loader)
+        # the original exception rides along, traceback intact
+        assert isinstance(ei.value.__cause__, ValueError)
+        assert "shard 7 unreadable" in str(ei.value.__cause__)
+        assert ei.value.__cause__.__traceback__ is not None
+    finally:
+        loader.close()
+
+
+def test_dataloader_stall_raises_diagnosable_error():
+    ds = SyntheticDataset(32, 8)
+    release = threading.Event()
+
+    class Hangs:
+        vocab_size = 32
+
+        def batch(self, seed, step, b):
+            if step >= 1:
+                release.wait()  # a dead NFS mount, in effigy
+            return ds.batch(seed, step, b)
+
+    loader = DataLoader(Hangs(), batch_size=2, seed=0, start_step=0,
+                        stall_timeout=0.5)
+    try:
+        next(loader)
+        t0 = time.monotonic()
+        with pytest.raises(StallError, match="stuck fetching step 1"):
+            next(loader)
+        assert time.monotonic() - t0 < 5.0  # raised promptly, not hung
+    finally:
+        release.set()
+        loader.close()
+
+
+def test_train_cli_resilience_knobs(tmp_path):
+    """--preempt-grace / --step-timeout plumb through the CLI; a watchdog'd
+    run completes normally when nothing stalls."""
+    from orion_tpu.train import build_argparser, main
+
+    args = build_argparser().parse_args(
+        ["--preempt-grace", "7.5", "--step-timeout", "120"]
+    )
+    assert args.preempt_grace == 7.5 and args.step_timeout == 120.0
+
+    log = str(tmp_path / "m.jsonl")
+    rc = main([
+        "--config", "tiny", "--data", "synthetic", "--steps", "2",
+        "--batch-size", "2", "--seq-len", "16", "--dp", "1",
+        "--log-path", log,
+        "--preempt-grace", "30", "--step-timeout", "300",
+    ])
+    assert rc == 0
