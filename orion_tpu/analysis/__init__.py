@@ -1,19 +1,29 @@
-"""Static analysis for orion-tpu: AST lint rules + jaxpr contract audits.
+"""Static analysis for orion-tpu: AST lint + jaxpr contracts + SPMD audits.
 
-Two tiers, one CLI (``python -m orion_tpu.analysis``), both part of tier-1
+Three tiers, one CLI (``python -m orion_tpu.analysis``), all part of tier-1
 via tests/test_analysis.py:
 
 - **Tier A** (analysis/lint.py, analysis/rules/): AST lint over the package —
   JAX hazards (debug calls and tracer materialization under jit, unhashable
   static args, Python-loop jnp accumulation in hot paths, float64 leaks) and
-  repo contracts (pallas chunk guards, mutable defaults, bare excepts).
+  repo contracts (pallas chunk guards, mutable defaults, bare excepts,
+  unbounded waits, signal-unsafe handlers).
 - **Tier B** (analysis/jaxpr_audit.py): traces — never executes — the jitted
   train step, the LRA step, and the recurrent decode step on abstract shapes
   and asserts the declared contracts (collective-free O(1)-state decode,
   bf16 matmul policy, no host callbacks).
+- **Tier C** (analysis/spmd_audit.py, analysis/snapshots.py): traces the
+  sharded programs (dp train step, sp/ring attention paths, pipeline step)
+  under an abstract 8-device mesh and checks every collective against the
+  per-step budgets declared in parallel/budgets.py; lowers audited configs
+  to HLO and diffs op histogram / collectives / scan-carry bytes / cost
+  model / donation aliasing against golden snapshots (analysis/golden/,
+  regenerated via ``--update-golden``).
 
-Suppression: ``# orion: noqa[rule-id]`` on the finding's line; grandfathered
-findings live in analysis/baseline.json with a mandatory rationale.
+Suppression: ``# orion: noqa[rule-id]`` on (any physical line of) the
+finding's logical line; grandfathered findings live in analysis/baseline.json
+with a mandatory rationale. ``--format json`` emits machine-readable
+findings with suppressed/baselined status for CI.
 """
 
 from orion_tpu.analysis.findings import (  # noqa: F401

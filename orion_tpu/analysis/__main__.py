@@ -1,10 +1,18 @@
-"""``python -m orion_tpu.analysis`` — run both analysis tiers; exit non-zero
+"""``python -m orion_tpu.analysis`` — run the analysis tiers; exit non-zero
 on any finding that is neither ``# orion: noqa[rule-id]``-suppressed nor
-baselined (analysis/baseline.json) with a rationale."""
+baselined (analysis/baseline.json) with a rationale.
+
+Tiers: A = AST lint, B = jaxpr contracts, C = SPMD collective budgets
+(``--tier spmd``) + golden compile-artifact snapshots (``--tier golden``).
+``--update-golden`` regenerates the snapshots under analysis/golden/ for
+PRs that intentionally change the compiled program. ``--format json``
+emits machine-readable findings (suppressed/baselined included, with
+status) for CI and bots."""
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import List
@@ -13,30 +21,59 @@ from typing import List
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "orion_tpu.analysis",
-        description="orion-tpu static analysis: AST lint + jaxpr contracts",
+        description="orion-tpu static analysis: AST lint + jaxpr contracts "
+        "+ SPMD collective budgets + golden compile snapshots",
     )
     p.add_argument(
         "paths", nargs="*",
         help="files/dirs to lint (default: the orion_tpu package)",
     )
     p.add_argument(
-        "--tier", choices=["lint", "jaxpr", "all"], default="all",
-        help="lint = Tier A AST rules only; jaxpr = Tier B contract audit "
-        "only (traces the train/LRA/decode steps on abstract shapes)",
+        "--tier", choices=["lint", "jaxpr", "spmd", "golden", "all"],
+        default="all",
+        help="lint = Tier A AST rules; jaxpr = Tier B contract audit "
+        "(traces the train/LRA/decode steps on abstract shapes); spmd = "
+        "Tier C collective-budget audit (traces the sharded paths under "
+        "an abstract 8-device mesh); golden = Tier C compile-artifact "
+        "snapshot diff",
     )
     p.add_argument(
         "--baseline", default=None,
         help="baseline JSON (default: orion_tpu/analysis/baseline.json); "
         "'none' disables baselining",
     )
+    p.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="json: one object per finding (rule, path, line, message, "
+        "status incl. suppressed/baselined) — for CI consumption",
+    )
+    p.add_argument(
+        "--update-golden", action="store_true",
+        help="regenerate the golden compile-artifact snapshots "
+        "(orion_tpu/analysis/golden/) and exit — for PRs that "
+        "intentionally change the compiled program",
+    )
+    p.add_argument(
+        "--golden-dir", default=None,
+        help="override the golden snapshot directory (tests)",
+    )
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule/contract catalog and exit")
     args = p.parse_args(argv)
 
-    from orion_tpu.analysis import jaxpr_audit
+    # Tier C traces/compiles against the abstract 8-virtual-CPU-device
+    # mesh; configure jax BEFORE anything initializes a backend (tier B
+    # would otherwise bring up a 1-device world first)
+    if args.update_golden or args.tier in ("spmd", "golden", "all"):
+        from orion_tpu.analysis.spmd_audit import ensure_cpu_devices
+
+        ensure_cpu_devices()
+
+    from orion_tpu.analysis import jaxpr_audit, snapshots, spmd_audit
     from orion_tpu.analysis.findings import (
         DEFAULT_BASELINE,
         Finding,
+        annotate_baseline,
         apply_baseline,
         load_baseline,
     )
@@ -50,7 +87,28 @@ def main(argv=None) -> int:
         print("Tier B (jaxpr contracts):")
         for cid in jaxpr_audit.ALL_CONTRACTS:
             print(f"  {cid}")
+        print("Tier C (SPMD budgets + golden snapshots):")
+        for cid in spmd_audit.ALL_SPMD_CHECKS + snapshots.ALL_GOLDEN_CHECKS:
+            print(f"  {cid}")
         return 0
+
+    golden_dir = args.golden_dir or snapshots.GOLDEN_DIR
+    if args.update_golden:
+        findings = snapshots.audit_golden(update=True, golden_dir=golden_dir)
+        if args.format == "json":
+            print(json.dumps({
+                "updated": sorted(snapshots.SNAPSHOT_TARGETS),
+                "golden_dir": golden_dir,
+                "findings": [f.to_json() for f in findings],
+            }, indent=2))
+        else:
+            for f in findings:
+                print(f.format())
+            print(
+                f"golden snapshots regenerated under {golden_dir} — commit "
+                "them with the PR that changes the compiled program"
+            )
+        return 1 if findings else 0
 
     repo_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -62,21 +120,60 @@ def main(argv=None) -> int:
     else:
         baseline = load_baseline(args.baseline or DEFAULT_BASELINE)
 
+    keep = args.format == "json"
+
+    def finish(fs: List[Finding]) -> List[Finding]:
+        """Baseline the non-lint tiers (lint_paths baselines internally)."""
+        return (
+            annotate_baseline(fs, baseline)
+            if keep
+            else apply_baseline(fs, baseline)
+        )
+
     findings: List[Finding] = []
     if args.tier in ("lint", "all"):
-        findings += lint_paths(paths, baseline=baseline, root=repo_root)
+        findings += lint_paths(
+            paths, baseline=baseline, root=repo_root, keep_suppressed=keep
+        )
     if args.tier in ("jaxpr", "all"):
-        findings += apply_baseline(jaxpr_audit.audit_repo(), baseline)
+        findings += finish(jaxpr_audit.audit_repo())
+    if args.tier in ("spmd", "all"):
+        findings += finish(spmd_audit.audit_spmd())
+    if args.tier in ("golden", "all"):
+        findings += finish(snapshots.audit_golden(golden_dir=golden_dir))
 
-    for f in findings:
+    active = [f for f in findings if f.status == "active"]
+    tiers = {
+        "lint": "tier A", "jaxpr": "tier B", "spmd": "tier C/spmd",
+        "golden": "tier C/golden", "all": "tiers A+B+C",
+    }
+    if args.format == "json":
+        doc = {
+            "tier": args.tier,
+            "findings": [f.to_json() for f in findings],
+            "counts": {
+                "active": len(active),
+                "suppressed": sum(
+                    1 for f in findings if f.status == "suppressed"
+                ),
+                "baselined": sum(
+                    1 for f in findings if f.status == "baselined"
+                ),
+            },
+        }
+        print(json.dumps(doc, indent=2))
+        return 1 if active else 0
+
+    for f in active:
         print(f.format())
-    n = len(findings)
-    tiers = {"lint": "tier A", "jaxpr": "tier B", "all": "tiers A+B"}
+    n = len(active)
     if n:
         print(
             f"\n{n} finding(s) ({tiers[args.tier]}). Fix them, suppress a "
-            "false positive in-line with `# orion: noqa[rule-id]`, or "
-            "baseline it with a reason in orion_tpu/analysis/baseline.json.",
+            "false positive in-line with `# orion: noqa[rule-id]`, baseline "
+            "it with a reason in orion_tpu/analysis/baseline.json, or — for "
+            "an intentional compiled-program change — rerun with "
+            "--update-golden and commit the new snapshot.",
             file=sys.stderr,
         )
         return 1

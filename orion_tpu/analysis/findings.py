@@ -33,9 +33,20 @@ class Finding:
     path: str  # repo-relative posix path (or "<target>" for jaxpr audits)
     line: int
     message: str
+    # "active" findings gate the CLI; "suppressed" (in-line noqa) and
+    # "baselined" ones are carried only by the machine-readable output
+    # (--format json) so CI/bots see the full picture, and default-compare
+    # equal to pre-status findings everywhere else
+    status: str = "active"
 
     def format(self) -> str:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "message": self.message, "status": self.status,
+        }
 
 
 def normalize_path(path: str, root: str = "") -> str:
@@ -72,14 +83,31 @@ def load_baseline(path: str = DEFAULT_BASELINE) -> List[BaselineEntry]:
     return entries
 
 
+def annotate_baseline(
+    findings: Iterable[Finding], baseline: Sequence[BaselineEntry]
+) -> List[Finding]:
+    """Mark grandfathered findings ``status="baselined"`` instead of
+    dropping them — the --format json path, where CI wants to see muted
+    alarms too. Already-suppressed findings keep their status."""
+    keys = {(b.rule, b.path) for b in baseline}
+    return [
+        dataclasses.replace(f, status="baselined")
+        if f.status == "active" and (f.rule, f.path) in keys
+        else f
+        for f in findings
+    ]
+
+
 def apply_baseline(
     findings: Iterable[Finding], baseline: Sequence[BaselineEntry]
 ) -> List[Finding]:
-    keys = {(b.rule, b.path) for b in baseline}
-    return [f for f in findings if (f.rule, f.path) not in keys]
+    return [
+        f for f in annotate_baseline(findings, baseline)
+        if f.status != "baselined"
+    ]
 
 
 __all__ = [
     "Finding", "BaselineEntry", "load_baseline", "apply_baseline",
-    "normalize_path", "DEFAULT_BASELINE",
+    "annotate_baseline", "normalize_path", "DEFAULT_BASELINE",
 ]

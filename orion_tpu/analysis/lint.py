@@ -14,7 +14,11 @@ tree plus the two pieces of derived information most rules share:
   don't false-positive.
 - **line suppression** — ``# orion: noqa[rule-id]`` (or several ids,
   comma-separated) on the finding's line suppresses it; a bare
-  ``# orion: noqa`` suppresses every rule on that line.
+  ``# orion: noqa`` suppresses every rule on that line. Suppression works
+  on LOGICAL lines: a statement spanning several physical lines (tokenized
+  the way the compiler does) is suppressed by a noqa on any of them, so a
+  finding reported against a multi-line call's first line is covered by a
+  trailing comment after the closing paren and vice versa.
 
 ``lint_source`` checks one in-memory module (what the unit tests use);
 ``lint_paths`` walks files and applies the baseline.
@@ -210,9 +214,46 @@ class ModuleContext:
             )
         return out
 
+    @cached_property
+    def logical_lines(self) -> Dict[int, range]:
+        """physical line -> the physical-line range of its logical line.
+
+        Logical lines come from the tokenizer (a NEWLINE token ends one;
+        NL/COMMENT inside brackets do not), so a multi-line call or def
+        header is ONE suppression unit while a function body is not —
+        a bare noqa on a ``def`` line never mutes the whole function."""
+        import io
+        import tokenize
+
+        out: Dict[int, range] = {}
+        start: Optional[int] = None
+        skip = (
+            tokenize.NL, tokenize.COMMENT, tokenize.INDENT,
+            tokenize.DEDENT, tokenize.ENDMARKER,
+        )
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline
+            )
+            for tok in tokens:
+                if tok.type == tokenize.NEWLINE:
+                    if start is not None:
+                        span = range(start, tok.end[0] + 1)
+                        for ln in span:
+                            out[ln] = span
+                    start = None
+                elif tok.type not in skip and start is None:
+                    start = tok.start[0]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return {}  # unparseable tail: fall back to physical-line noqa
+        return out
+
     def suppressed(self, finding: Finding) -> bool:
-        ids = self.noqa_lines.get(finding.line)
-        return ids is not None and (ids is NOQA_ALL or finding.rule in ids)
+        for line in self.logical_lines.get(finding.line, (finding.line,)):
+            ids = self.noqa_lines.get(line)
+            if ids is not None and (ids is NOQA_ALL or finding.rule in ids):
+                return True
+        return False
 
 
 def _is_trace_decorator(node: ast.AST) -> bool:
@@ -234,13 +275,25 @@ def lint_source(
     path: str = "<memory>",
     rules=None,
     root: str = "",
+    keep_suppressed: bool = False,
 ) -> List[Finding]:
-    """Lint one module's source; returns unsuppressed findings, sorted."""
+    """Lint one module's source; returns unsuppressed findings, sorted.
+    ``keep_suppressed`` keeps noqa'd findings with ``status="suppressed"``
+    (the --format json path) instead of dropping them."""
+    import dataclasses
+
     ctx = ModuleContext(source, path, root)
     findings: List[Finding] = []
     for rule in rules if rules is not None else default_rules():
         findings.extend(rule.check(ctx))
-    findings = [f for f in findings if not ctx.suppressed(f)]
+    if keep_suppressed:
+        findings = [
+            dataclasses.replace(f, status="suppressed")
+            if ctx.suppressed(f) else f
+            for f in findings
+        ]
+    else:
+        findings = [f for f in findings if not ctx.suppressed(f)]
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 
@@ -263,13 +316,17 @@ def lint_paths(
     rules=None,
     baseline: Sequence[BaselineEntry] = (),
     root: str = "",
+    keep_suppressed: bool = False,
 ) -> List[Finding]:
     findings: List[Finding] = []
     for path in iter_py_files(paths):
         with open(path, encoding="utf-8") as f:
             source = f.read()
         try:
-            findings.extend(lint_source(source, path, rules=rules, root=root))
+            findings.extend(lint_source(
+                source, path, rules=rules, root=root,
+                keep_suppressed=keep_suppressed,
+            ))
         except SyntaxError as e:
             # the engine must never crash on the code under audit — an
             # unparseable file is itself a (non-suppressable) finding
@@ -277,6 +334,10 @@ def lint_paths(
                 "parse-error", normalize_path(path, root), e.lineno or 0,
                 f"file does not parse: {e.msg}",
             ))
+    if keep_suppressed:
+        from orion_tpu.analysis.findings import annotate_baseline
+
+        return annotate_baseline(findings, baseline)
     return apply_baseline(findings, baseline)
 
 

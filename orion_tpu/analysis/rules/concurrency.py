@@ -15,15 +15,33 @@ be ``str.join``/``os.path.join`` — both require operands — so it is a
 thread/process join. Calls carrying a ``timeout=`` kwarg pass. Test code is
 exempt (tests may legitimately block on a result); real exceptions use the
 standard ``# orion: noqa[unbounded-wait]`` / baseline escape hatch.
+
+``signal-unsafe-handler`` — a Python signal handler runs between two
+                     arbitrary bytecodes of whatever the main thread was
+                     doing. Buffered I/O (``print``, ``open``,
+                     ``.write``/``.flush``), lock acquisition, and
+                     checkpoint saves inside the handler can re-enter a
+                     lock the interrupted code already holds (logging and
+                     io buffers lock internally) and deadlock exactly at
+                     preemption time — the moment the resilience stack
+                     most needs to work. Handlers must only set flags
+                     (resilience/preempt.py: the trainer polls at step
+                     boundaries, where the emergency checkpoint runs);
+                     ``os.write`` is exempt — the unbuffered syscall is
+                     the one async-signal-safe way to say something.
+
+Detection: every function registered via ``signal.signal(sig, fn)`` (by
+name or as a ``self.method`` attribute), closed over same-module calls —
+a helper the handler calls is part of the handler.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import Iterator, List, Set
 
 from orion_tpu.analysis.findings import Finding
-from orion_tpu.analysis.lint import ModuleContext
+from orion_tpu.analysis.lint import ModuleContext, dotted_name
 
 
 class UnboundedWaitRule:
@@ -59,4 +77,91 @@ class UnboundedWaitRule:
             )
 
 
-RULES = [UnboundedWaitRule()]
+class SignalUnsafeHandlerRule:
+    id = "signal-unsafe-handler"
+    title = "I/O, lock, or checkpoint call inside a signal handler"
+
+    # attribute calls that do buffered I/O / take locks / save state; the
+    # logger-method names catch the dominant `log = logging.getLogger(...)
+    # ... log.warning(...)` idiom, which locks exactly like direct
+    # `logging.*` calls (in a handler, any `.info()` is a logger)
+    _UNSAFE_ATTRS = frozenset({
+        "write", "read", "flush", "acquire", "save", "maybe_save", "wait",
+        "debug", "info", "warning", "error", "critical", "exception", "log",
+    })
+    # fully-dotted exemptions: the async-signal-safe raw syscalls
+    _SAFE_DOTTED = frozenset({"os.write", "os.read"})
+    _UNSAFE_NAMES = frozenset({"print", "open", "input"})
+
+    def _handler_names(self, ctx: ModuleContext) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) != "signal.signal" or len(node.args) < 2:
+                continue
+            target = node.args[1]
+            name = dotted_name(target)
+            if name:
+                out.add(name.rsplit(".", 1)[-1])
+        return out
+
+    def _handler_defs(self, ctx: ModuleContext) -> List[ast.AST]:
+        """Registered handlers plus (fixpoint) every same-module function
+        they call by name — a helper the handler calls runs in handler
+        context too."""
+        by_name = {}
+        for fn in ctx.function_defs:
+            by_name.setdefault(fn.name, []).append(fn)
+        frontier = [
+            fn for name in self._handler_names(ctx)
+            for fn in by_name.get(name, [])
+        ]
+        reach: List[ast.AST] = []
+        while frontier:
+            fn = frontier.pop()
+            if fn in reach:
+                continue
+            reach.append(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = dotted_name(node.func)
+                    if callee:
+                        frontier.extend(
+                            by_name.get(callee.rsplit(".", 1)[-1], [])
+                        )
+        return reach
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.is_test:
+            return  # tests may exercise deliberately-unsafe toy handlers
+        for fn in self._handler_defs(ctx):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                unsafe = None
+                if name in self._UNSAFE_NAMES:
+                    unsafe = f"{name}()"
+                elif name and name.split(".", 1)[0] == "logging":
+                    unsafe = f"{name}() (logging locks internally)"
+                elif isinstance(node.func, ast.Attribute):
+                    if (
+                        node.func.attr in self._UNSAFE_ATTRS
+                        and name not in self._SAFE_DOTTED
+                    ):
+                        unsafe = f".{node.func.attr}()"
+                if unsafe is None:
+                    continue
+                yield Finding(
+                    self.id, ctx.path, node.lineno,
+                    f"{unsafe} inside signal handler `{fn.name}`: handlers "
+                    "run between arbitrary bytecodes and can deadlock on "
+                    "io/logging locks the interrupted code holds — only "
+                    "set flags (poll at step boundaries, "
+                    "resilience/preempt.py) and use os.write for "
+                    "last-resort messages",
+                )
+
+
+RULES = [UnboundedWaitRule(), SignalUnsafeHandlerRule()]

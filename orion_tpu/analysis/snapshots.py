@@ -1,0 +1,308 @@
+"""Tier C (part 2): golden compile-artifact snapshots.
+
+Budget checks (spmd_audit.py) see the jaxpr; this module looks one layer
+down, at what XLA actually compiled. Each target in
+:data:`SNAPSHOT_TARGETS` is lowered and compiled on the deterministic
+8-virtual-CPU-device mesh and summarized into a small JSON artifact:
+
+- ``op_histogram``     — optimized-HLO opcode counts (fusions included):
+  the compiled program's shape, insensitive to register names.
+- ``hlo_collectives``  — all-reduce / all-gather / reduce-scatter /
+  all-to-all / collective-permute counts in the optimized HLO — the
+  communication GSPMD actually inserted from the shardings (the jaxpr of
+  the auto-sharded train step shows none of these).
+- ``scan_carry_bytes`` — byte size of the largest scan's carry (the
+  decode target's O(1)-state budget in bytes).
+- ``flops`` / ``bytes_accessed`` — the compiler's own cost model.
+- ``donation``         — declared donated input buffers vs the aliases
+  XLA accepted. A donated arg XLA refuses to alias silently doubles that
+  buffer's HBM footprint: surfaced as ``donated-arg-unaliased``.
+
+Snapshots are stored under ``orion_tpu/analysis/golden/`` and regenerated
+with ``python -m orion_tpu.analysis --update-golden``. The audit recompiles
+each target and diffs against the stored file with a human-readable delta,
+so any PR that changes the compiled program must either update the golden
+file (making the change reviewable) or fail tier-1:
+
+- ``golden-snapshot-missing`` — no stored artifact for a target.
+- ``golden-snapshot-drift``   — stored vs fresh mismatch (delta in the
+  finding message).
+
+Generation is deterministic on CPU: same jax/jaxlib + same config =>
+byte-identical JSON (asserted by tests regenerating in-process).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from orion_tpu.analysis.findings import Finding
+from orion_tpu.analysis.jaxpr_audit import AUDIT_ERROR, scan_carry_avals
+from orion_tpu.analysis.spmd_audit import ensure_cpu_devices
+
+RULE_DRIFT = "golden-snapshot-drift"
+RULE_MISSING = "golden-snapshot-missing"
+RULE_DONATION = "donated-arg-unaliased"
+
+ALL_GOLDEN_CHECKS = (RULE_DRIFT, RULE_MISSING, RULE_DONATION)
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+_MAX_DELTA_LINES = 20
+
+
+# -- HLO text extraction ------------------------------------------------------
+
+# "%name = shape opcode(...)" — shape is either a bare token or a tuple
+_OP_RE = re.compile(
+    r"(?m)^\s*(?:ROOT\s+)?%?[\w.\-]+ = (?:\([^)]*\)|\S+) ([a-z][a-z0-9\-]*)\("
+)
+
+_HLO_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+
+def op_histogram(hlo_text: str) -> Dict[str, int]:
+    return dict(sorted(collections.Counter(_OP_RE.findall(hlo_text)).items()))
+
+
+def hlo_collective_counts(hlo_text: str) -> Dict[str, int]:
+    return {
+        op: len(re.findall(rf"\b{op}(?:-start)?\(", hlo_text))
+        for op in _HLO_COLLECTIVES
+    }
+
+
+def alias_count(hlo_text: str) -> int:
+    """Input/output aliases XLA ACCEPTED (entry-computation
+    ``input_output_alias`` entries)."""
+    return hlo_text.count("may-alias") + hlo_text.count("must-alias")
+
+
+def _carry_bytes(closed_jaxpr) -> Optional[int]:
+    import numpy as np
+
+    carries = scan_carry_avals(closed_jaxpr.jaxpr)
+    if carries is None:
+        return None
+    total = 0
+    for shape, dtype in carries:
+        n = int(np.prod(shape)) if shape else 1
+        total += n * np.dtype(dtype).itemsize
+    return total
+
+
+def _cost_ints(compiled) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    try:
+        ca = compiled.cost_analysis()
+        ca0 = ca[0] if isinstance(ca, (list, tuple)) else ca
+        for key, name in (("flops", "flops"), ("bytes accessed", "bytes_accessed")):
+            v = ca0.get(key)
+            if v is not None:
+                out[name] = int(v)
+    except Exception as e:  # backend-dependent introspection
+        out["cost_analysis_error"] = f"{type(e).__name__}: {e}"[:120]
+    return out
+
+
+# -- targets ------------------------------------------------------------------
+
+
+def _snap_train_tiny_dp8() -> Tuple[Any, Any, Dict[str, Any]]:
+    """The donated, GSPMD-sharded tiny train step on the dp=8 mesh — the
+    artifact that proves the sharding rules engage (all-reduces present)
+    and donation aliases (state updated in place). Built from the SAME
+    trainer the Tier C budget audit traces (spmd_audit.tiny_dp8_trainer)
+    so budget and snapshot can never drift onto different programs."""
+    import jax
+
+    from orion_tpu.analysis.spmd_audit import tiny_dp8_trainer
+
+    tr, batch = tiny_dp8_trainer()
+    jaxpr = jax.make_jaxpr(tr._train_step)(tr._abstract, batch)
+    lowered = tr._step_fn.lower(tr.abstract_state(), batch)
+    meta = {
+        "mesh": {k: int(v) for k, v in tr.mesh.shape.items()},
+        "batch_size": tr.cfg.batch_size,
+        "seq_len": tr.cfg.seq_len,
+        # _step_fn donates the whole TrainState (donate_argnums=(0,))
+        "donated_args": len(jax.tree.leaves(tr.abstract_state())),
+    }
+    return jaxpr, lowered, meta
+
+
+def _snap_decode_tiny() -> Tuple[Any, Any, Dict[str, Any]]:
+    """The jitted recurrent decode step — the O(1)-state artifact (its
+    scan carry bytes ARE the per-token state budget)."""
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import SampleConfig, _generate_jit
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(get_config("tiny"))
+    key = jax.random.PRNGKey(0)
+    prompt = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    params = jax.eval_shape(model.init, key, prompt)
+    fn = jax.jit(_generate_jit, static_argnums=(0, 3, 4))
+    args = (model, params, prompt, 8, SampleConfig(), key)
+    jaxpr = jax.make_jaxpr(_generate_jit, static_argnums=(0, 3, 4))(*args)
+    lowered = fn.lower(*args)
+    meta = {"prompt_len": 8, "max_new_tokens": 8, "donated_args": 0}
+    return jaxpr, lowered, meta
+
+
+# name -> () -> (closed_jaxpr, lowered, meta). Golden files live at
+# golden/<name>.json; adding a target here + --update-golden creates one.
+SNAPSHOT_TARGETS: Dict[str, Callable[[], Tuple[Any, Any, Dict[str, Any]]]] = {
+    "train_tiny_dp8": _snap_train_tiny_dp8,
+    "decode_tiny": _snap_decode_tiny,
+}
+
+
+def build_snapshot(name: str) -> Dict[str, Any]:
+    jaxpr, lowered, meta = SNAPSHOT_TARGETS[name]()
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
+    snap: Dict[str, Any] = {
+        "target": name,
+        **meta,
+        "op_histogram": op_histogram(hlo),
+        "hlo_collectives": hlo_collective_counts(hlo),
+        "scan_carry_bytes": _carry_bytes(jaxpr),
+        "donation": {
+            "donated_args": meta.get("donated_args", 0),
+            "aliased": alias_count(hlo),
+        },
+    }
+    snap.pop("donated_args", None)
+    snap.update(_cost_ints(compiled))
+    return snap
+
+
+# -- diff + audit -------------------------------------------------------------
+
+
+def _flatten(d: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k in sorted(d):
+        key = f"{prefix}{k}"
+        if isinstance(d[k], dict):
+            out.update(_flatten(d[k], key + "."))
+        else:
+            out[key] = d[k]
+    return out
+
+
+def diff_report(golden: Dict[str, Any], fresh: Dict[str, Any]) -> List[str]:
+    """Human-readable delta lines, golden -> fresh; empty == identical."""
+    g, f = _flatten(golden), _flatten(fresh)
+    lines = []
+    for k in sorted(set(g) | set(f)):
+        if k not in g:
+            lines.append(f"+ {k} = {f[k]!r} (not in golden)")
+        elif k not in f:
+            lines.append(f"- {k} = {g[k]!r} (gone from fresh build)")
+        elif g[k] != f[k]:
+            lines.append(f"~ {k}: {g[k]!r} -> {f[k]!r}")
+    return lines
+
+
+def donation_findings(snap: Dict[str, Any], path: str) -> List[Finding]:
+    """A donated buffer XLA refused to alias is a live memory regression
+    regardless of what the golden file says — checked at build time."""
+    d = snap.get("donation") or {}
+    donated, aliased = d.get("donated_args", 0), d.get("aliased", 0)
+    if donated and aliased < donated:
+        return [Finding(
+            RULE_DONATION, path, 0,
+            f"{snap.get('target', path)}: {donated} donated input "
+            f"buffer(s) but XLA aliased only {aliased} — each refused "
+            "alias keeps both the argument and the output live "
+            "(double HBM for that buffer); check dtype/sharding changes "
+            "to the donated state",
+        )]
+    return []
+
+
+def golden_path(name: str, golden_dir: str = GOLDEN_DIR) -> str:
+    return os.path.join(golden_dir, f"{name}.json")
+
+
+def write_golden(name: str, snap: Dict[str, Any], golden_dir: str = GOLDEN_DIR) -> str:
+    os.makedirs(golden_dir, exist_ok=True)
+    p = golden_path(name, golden_dir)
+    with open(p, "w", encoding="utf-8") as f:
+        json.dump(snap, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return p
+
+
+def audit_golden(
+    update: bool = False,
+    golden_dir: str = GOLDEN_DIR,
+    fresh: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> List[Finding]:
+    """Rebuild every snapshot target and diff against the checked-in golden
+    files (``update=True`` rewrites them instead). ``fresh`` supplies
+    prebuilt snapshots (tests share one expensive build across cases)."""
+    err = ensure_cpu_devices()
+    if err is not None:
+        return [Finding(AUDIT_ERROR, "<golden>", 0, err)]
+
+    findings: List[Finding] = []
+    for name in SNAPSHOT_TARGETS:
+        rel = f"orion_tpu/analysis/golden/{name}.json"
+        try:
+            snap = fresh[name] if fresh and name in fresh else build_snapshot(name)
+        except Exception as e:  # noqa: BLE001 - surfaced as finding, not crash
+            findings.append(Finding(
+                AUDIT_ERROR, f"<golden:{name}>", 0,
+                f"building snapshot {name} failed: {type(e).__name__}: {e}",
+            ))
+            continue
+        findings.extend(donation_findings(snap, rel))
+        if update:
+            write_golden(name, snap, golden_dir)
+            continue
+        gp = golden_path(name, golden_dir)
+        if not os.path.exists(gp):
+            findings.append(Finding(
+                RULE_MISSING, rel, 0,
+                f"no golden snapshot for {name}; run "
+                "`python -m orion_tpu.analysis --update-golden` and commit "
+                "the result",
+            ))
+            continue
+        with open(gp, encoding="utf-8") as f:
+            golden = json.load(f)
+        delta = diff_report(golden, snap)
+        if delta:
+            shown = delta[:_MAX_DELTA_LINES]
+            if len(delta) > len(shown):
+                shown.append(f"... {len(delta) - len(shown)} more line(s)")
+            findings.append(Finding(
+                RULE_DRIFT, rel, 0,
+                f"compiled artifact for {name} drifted from its golden "
+                f"snapshot ({len(delta)} delta line(s)):\n    "
+                + "\n    ".join(shown)
+                + "\n    intentional? rerun with --update-golden and commit "
+                "the new snapshot so the change is reviewed",
+            ))
+    return findings
+
+
+__all__ = [
+    "audit_golden", "build_snapshot", "diff_report", "donation_findings",
+    "op_histogram", "hlo_collective_counts", "alias_count", "write_golden",
+    "golden_path", "SNAPSHOT_TARGETS", "GOLDEN_DIR", "ALL_GOLDEN_CHECKS",
+    "RULE_DRIFT", "RULE_MISSING", "RULE_DONATION",
+]

@@ -1,0 +1,154 @@
+"""Per-step collective budgets — the `parallel/` layer's declared
+communication contract, checked by analysis Tier C (analysis/spmd_audit.py).
+
+Each named step is one trace target the auditor runs under an abstract
+multi-device mesh; its budget says exactly which collective primitives the
+traced program may contain, how many, with which payload dtypes, and
+whether they belong inside a per-step loop body. The point is that the
+costs this repo's headline numbers rest on are STRUCTURAL: one ring hop
+per step, one state all_gather per layer, zero explicit collectives in the
+GSPMD train step. A stray ``psum`` added inside a scan body, an accidental
+f32 payload, or a third ppermute per ring step never fails a CPU parity
+test — it only shows up as a silent slowdown on hardware CI doesn't have.
+Declaring the budget next to the code makes the regression a tier-1
+failure instead: change the communication structure and you must change
+the budget (with the diff reviewed) in the same PR.
+
+Semantics per :class:`Allow` entry:
+
+- ``max_count``  — ceiling on eqn occurrences of ``prim`` in the traced
+  jaxpr (forward AND autodiff-generated collectives count; AD transposes
+  of ppermute/psum land in the same jaxpr).
+- ``dtypes``     — allowed payload dtypes. An f32 payload where bf16 is
+  declared doubles ICI bytes without failing any parity test.
+- ``hoistable``  — True means this collective has no business inside a
+  ``lax.scan``/``while`` body: it is loop-invariant (or pre-loop layout
+  work) and a copy inside the loop multiplies its cost by the trip count.
+  Collectives that ARE the loop (the ring's per-step neighbor hop, the
+  pipeline's stage rotation) set False.
+
+A primitive with no entry at all is unbudgeted — any occurrence is a
+finding. The budget keys must stay in sync with
+``analysis/spmd_audit.py::SPMD_TARGETS`` (tested).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Allow:
+    prim: str  # jaxpr primitive name (psum, ppermute, all_gather, ...)
+    max_count: int
+    dtypes: Tuple[str, ...]
+    hoistable: bool = False
+    note: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class StepBudget:
+    step: str
+    allows: Tuple[Allow, ...] = ()
+    note: str = ""
+
+    def entry_for(self, prim: str) -> Optional[Allow]:
+        for a in self.allows:
+            if a.prim == prim:
+                return a
+        return None
+
+
+BUDGETS: Dict[str, StepBudget] = {
+    # training/trainer.py::_train_step on a pure-dp mesh. The GSPMD design
+    # contract (parallel/collectives.py docstring): the jitted train step
+    # calls NO collectives — jit inserts every all-reduce/all-gather from
+    # the shardings. An explicit collective here means a manual shard_map
+    # path leaked into the auto-sharded step.
+    "train_step_dp": StepBudget(
+        step="train_step_dp",
+        allows=(),
+        note="GSPMD-only: all communication comes from sharding annotations",
+    ),
+    # parallel/sequence.py::sp_linear_attention — cross-shard kv-cumsum
+    # correction. One all_gather per exclusive_prefix_sum call (S and z),
+    # f32 by design: the gathered tensors are the per-shard STATES
+    # ([Dk, Dv] per head — bytes, not activations) whose f32 accumulation
+    # is the numerics contract (configs.py::F32_MATMUL_SCOPES).
+    "sp_linear_attention": StepBudget(
+        step="sp_linear_attention",
+        allows=(
+            Allow("all_gather", max_count=2, dtypes=("float32",),
+                  hoistable=True,
+                  note="tiny per-shard (S, z) states; loop-invariant"),
+        ),
+        note="one state all_gather pair per layer, O(D^2) bytes, T-free",
+    ),
+    # parallel/ring.py::ring_attention (contiguous causal). The ring IS the
+    # loop: exactly one (k, v) ppermute pair per fori_loop step, payload in
+    # the activation dtype.
+    "ring_attention_causal": StepBudget(
+        step="ring_attention_causal",
+        allows=(
+            Allow("ppermute", max_count=2, dtypes=("bfloat16",),
+                  hoistable=False, note="the per-step kv ring hop"),
+        ),
+    ),
+    # Same path with a sliding window: identical ring structure (skipped
+    # blocks still rotate — the ring must complete).
+    "ring_attention_window": StepBudget(
+        step="ring_attention_window",
+        allows=(
+            Allow("ppermute", max_count=2, dtypes=("bfloat16",),
+                  hoistable=False, note="the per-step kv ring hop"),
+        ),
+    ),
+    # parallel/ring.py::ring_attention(striped=True) — load-balanced
+    # layout. Adds the striping exchanges: one all_to_all per q/k/v on the
+    # way in plus one for the output on the way out, all OUTSIDE the loop
+    # (layout work happens once, not per ring step).
+    "ring_attention_striped": StepBudget(
+        step="ring_attention_striped",
+        allows=(
+            Allow("ppermute", max_count=2, dtypes=("bfloat16",),
+                  hoistable=False, note="the per-step kv ring hop"),
+            Allow("all_to_all", max_count=4, dtypes=("bfloat16",),
+                  hoistable=True,
+                  note="striped layout in (q,k,v) + out; once per call"),
+        ),
+    ),
+    # parallel/ring.py::swa_halo_attention — sliding window as a halo
+    # exchange: h neighbor ppermute pairs, unrolled (h is static), never
+    # inside a loop. Trace config uses window=24, T_local=16 => h=2.
+    "swa_halo_attention": StepBudget(
+        step="swa_halo_attention",
+        allows=(
+            Allow("ppermute", max_count=4, dtypes=("bfloat16",),
+                  hoistable=True,
+                  note="h=2 halo hops x (k, v); static unroll, O(h) not O(sp)"),
+        ),
+    ),
+    # parallel/pipeline.py via trainer pp=2 (full fwd+bwd train step). The
+    # stage rotation ppermute lives inside the GPipe scan (forward + its AD
+    # transpose = 2); the psums are the end-of-pipeline output broadcast,
+    # the aux reduction, and the AD transposes of pp-replicated inputs —
+    # all loop-invariant. A psum migrating INTO the scan body would run
+    # once per microbatch step: the classic silent pipeline slowdown.
+    "pipeline_lm_step": StepBudget(
+        step="pipeline_lm_step",
+        allows=(
+            Allow("ppermute", max_count=2, dtypes=("bfloat16",),
+                  hoistable=False,
+                  note="stage rotation: fwd + the bwd reverse pipeline"),
+            Allow("psum", max_count=14, dtypes=("bfloat16", "float32"),
+                  hoistable=True,
+                  note="output broadcast + aux + AD transposes of "
+                       "pp-replicated operands; once per call, not per step"),
+        ),
+        note="traced as the tiny-model pp=2 trainer step (fwd+bwd)",
+    ),
+}
+
+
+__all__ = ["Allow", "StepBudget", "BUDGETS"]

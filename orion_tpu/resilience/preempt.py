@@ -18,8 +18,8 @@ programmatic path with a warning rather than failing.
 
 from __future__ import annotations
 
+import os
 import signal
-import sys
 import threading
 import time
 import warnings
@@ -74,11 +74,17 @@ class PreemptionGuard:
             return
         self._requested_at = self._clock()
         self._signum = signum
-        sys.stderr.write(
+        # os.write, not sys.stderr.write: the handler runs between two
+        # arbitrary bytecodes, and buffered io locks internally — if the
+        # interrupted code holds that lock (a log line mid-flush), a
+        # buffered write here deadlocks at exactly the moment preemption
+        # handling must not. The raw fd-2 syscall is async-signal-safe.
+        # (analysis rule: signal-unsafe-handler)
+        os.write(2, (
             f"[preempt] caught signal {signum}: requesting graceful stop at "
             f"the next step boundary (grace {self.grace:.0f}s; signal again "
             "to kill)\n"
-        )
+        ).encode())
 
     def request_stop(self, signum: int = signal.SIGTERM) -> None:
         """Programmatic stop request (tests, non-main-thread embedders)."""
