@@ -119,7 +119,10 @@ class ModuleContext:
         p = self.path
         return any(
             s in p
-            for s in ("training/trainer", "generate", "/ops/", "train_lra")
+            for s in (
+                "training/trainer", "generate", "/ops/", "train_lra",
+                "serving/",
+            )
         ) or p.startswith("ops/")
 
     @property

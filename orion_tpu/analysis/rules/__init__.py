@@ -13,6 +13,7 @@ from typing import Dict
 
 from orion_tpu.analysis.rules import (
     concurrency,
+    decode,
     hygiene,
     jit_hygiene,
     pallas_guards,
@@ -20,7 +21,7 @@ from orion_tpu.analysis.rules import (
 )
 
 ALL_RULES: Dict[str, object] = {}
-for _mod in (jit_hygiene, perf, hygiene, pallas_guards, concurrency):
+for _mod in (jit_hygiene, perf, hygiene, pallas_guards, concurrency, decode):
     for _rule in _mod.RULES:
         assert _rule.id not in ALL_RULES, f"duplicate rule id {_rule.id}"
         ALL_RULES[_rule.id] = _rule

@@ -1,0 +1,88 @@
+"""Decode-path serving rules.
+
+``decode-host-sync`` — a host synchronization (``.block_until_ready()``,
+``.item()``, ``float()``, ``np.asarray``, ``jax.device_get``) inside a
+per-chunk decode loop stalls the device pipeline once per chunk: the next
+chunk's dispatch waits on the readback, turning the chunked serving walk
+into lockstep host-device ping-pong — the latency bug the chunked design
+exists to avoid. The serving layer has exactly ONE sanctioned sync per
+chunk — the scalar all-finite probe — and it lives in a designated probe
+function (``DecodeSession._probe_finite``), so the rule exempts any code
+lexically inside a function whose name contains ``probe``. Everything
+else syncs once, after the loop.
+
+Scope: the decode modules only (``orion_tpu/serving/`` and
+``generate.py``); host loops elsewhere (eval CLIs, data prep) may sync
+freely. Traced code is already covered by ``tracer-host``; this rule is
+about HOST loops driving the device.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from orion_tpu.analysis.findings import Finding
+from orion_tpu.analysis.lint import ModuleContext, dotted_name
+
+_SYNC_ATTRS = frozenset({"block_until_ready", "item"})
+_SYNC_NAMES = frozenset({"float"})
+_SYNC_DOTTED = frozenset({
+    "np.asarray", "numpy.asarray", "onp.asarray", "jax.device_get",
+})
+
+
+def _is_decode_module(path: str) -> bool:
+    return "serving/" in path or path.endswith("generate.py")
+
+
+def _inside_probe(node: ast.AST) -> bool:
+    cur = getattr(node, "_orion_parent", None)
+    while cur is not None:
+        if (
+            isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and "probe" in cur.name
+        ):
+            return True
+        cur = getattr(cur, "_orion_parent", None)
+    return False
+
+
+class DecodeHostSyncRule:
+    id = "decode-host-sync"
+    title = "host sync inside a per-chunk decode loop"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.is_test or not _is_decode_module(ctx.path):
+            return
+        seen = set()
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                name = dotted_name(node.func)
+                sync = None
+                if name in _SYNC_NAMES:
+                    sync = f"{name}()"
+                elif name in _SYNC_DOTTED:
+                    sync = f"{name}()"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_ATTRS
+                ):
+                    sync = f".{node.func.attr}()"
+                if sync is None or _inside_probe(node):
+                    continue
+                seen.add(id(node))
+                yield Finding(
+                    self.id, ctx.path, node.lineno,
+                    f"{sync} inside a decode loop forces a device round-"
+                    "trip every chunk; sync once after the loop, or move "
+                    "it into the designated probe (a function named "
+                    "*probe*, e.g. DecodeSession._probe_finite)",
+                )
+
+
+RULES = [DecodeHostSyncRule()]

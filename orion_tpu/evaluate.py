@@ -84,37 +84,32 @@ def main(argv=None) -> int:
                    help="evaluate through the weight-streamed decode model "
                         "(the quant acceptance bar: eval-ppl delta vs fp32 "
                         "on the same held-out data)")
+    p.add_argument("--ckpt-attempts", type=int, default=4,
+                   help="total tries for the checkpoint load (transient "
+                        "I/O retried with jittered backoff; 1 = no retry)")
     args = p.parse_args(argv)
 
     from orion_tpu.generate import load_params
+    from orion_tpu.resilience.retry import RetryPolicy
 
     cfg = get_config(args.config)
-    params, step = load_params(args.ckpt_dir, args.step)
-    # the architecture must match the checkpoint, not the named config:
-    # train.py auto-bumps max_seq_len when seq_len >= max_seq_len, so read
-    # the real positional capacity off the stored pos_embed table
-    try:
-        import dataclasses
+    # hardened serving-side loader (generate.load_params): retried I/O,
+    # manifest-verified params, and — when --step is NOT pinned — fallback
+    # to the newest intact step, so a torn latest checkpoint degrades the
+    # eval to slightly-stale params instead of killing it
+    params, step = load_params(
+        args.ckpt_dir, args.step,
+        retry=RetryPolicy(attempts=max(args.ckpt_attempts, 1)),
+    )
+    from orion_tpu.generate import adapt_config_to_params, unstack_if_pipeline
 
-        pos_rows = params["params"]["pos_embed"]["embedding"].shape[0]
-        if pos_rows != cfg.max_seq_len:
-            cfg = dataclasses.replace(cfg, max_seq_len=pos_rows)
-        # same for the vocab (train --set model.vocab_size=... runs)
-        vocab = params["params"]["embed"]["embedding"].shape[0]
-        if vocab != cfg.vocab_size:
-            cfg = dataclasses.replace(cfg, vocab_size=vocab)
-    except (KeyError, TypeError):
-        pass
+    cfg = adapt_config_to_params(cfg, params)
     assert args.seq_len < cfg.max_seq_len, (
         f"--seq-len {args.seq_len} needs positions up to {args.seq_len}, but "
         f"the checkpoint was trained with max_seq_len={cfg.max_seq_len}"
     )
     model = TransformerLM(cfg)
-    if "blocks_stacked" in params.get("params", {}):
-        # pipeline-trained checkpoint: convert to the standard layout
-        from orion_tpu.parallel.pipeline_lm import unstack_lm_params
-
-        params = unstack_lm_params(model, params)
+    params, _ = unstack_if_pipeline(model, params)
     if args.quant:
         from orion_tpu.generate import quantize_for_decode
 

@@ -51,21 +51,46 @@ def sample_logits(logits: Array, rng: Array, cfg: SampleConfig) -> Array:
     if cfg.greedy:
         return jnp.argmax(logits, axis=-1)
     logits = logits / cfg.temperature
-    if cfg.top_k and cfg.top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -cfg.top_k][:, None]
+    # clamp top_k to the vocab: a caller's top_k >= V means "no filtering",
+    # not an out-of-range [-top_k] index into the sorted row
+    k = min(cfg.top_k, logits.shape[-1]) if cfg.top_k > 0 else 0
+    if k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if cfg.top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        # keep the smallest prefix with cumulative prob >= top_p (always
-        # keeps the argmax); cutoff = lowest logit inside that prefix
+        # keep the smallest prefix with cumulative prob >= top_p; cutoff =
+        # lowest logit inside that prefix. The argmax survives
+        # unconditionally: a degenerate top_p <= 0 would otherwise mask
+        # every candidate and hand categorical an all--inf row (it then
+        # samples uniformly from garbage)
         keep = cum - probs < cfg.top_p
+        keep = keep.at[:, 0].set(True)
         cutoff = jnp.min(
             jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
         )
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)
+
+
+def _decode_body(model, params, sample_cfg: SampleConfig, rng, carry, i):
+    """One recurrent decode step: the SINGLE scan body shared by the
+    monolithic ``_generate_jit`` scan and the chunked ``decode_chunk``
+    scans, so chunked-vs-monolithic bitwise equivalence at a fixed rng is
+    by construction. ``i`` is the ABSOLUTE emitted-token index (the rng
+    fold_in key), regardless of which chunk is executing."""
+    token, states, t, done = carry
+    logits, states = model.apply(params, token, states, t, method="decode_step")
+    nxt = sample_logits(logits, jax.random.fold_in(rng, i + 1), sample_cfg)
+    if sample_cfg.eos_token >= 0:
+        # emit EOS itself, pad everything after it
+        emitted = jnp.where(done, sample_cfg.pad_token, token)
+        done = done | (emitted == sample_cfg.eos_token)
+    else:
+        emitted = token
+    return (nxt, states, t + 1, done), emitted
 
 
 @partial(jax.jit, static_argnums=(0, 3, 4))
@@ -79,25 +104,12 @@ def _generate_jit(
 ) -> Array:
     """prompt [B, T0] -> generated [B, max_new_tokens]."""
     t0 = prompt.shape[1]
-    use_eos = sample_cfg.eos_token >= 0
     # last-position-only head: the full-prompt [B, T, V] logits would cost
     # a T x D x V matmul + 4.3GB fp32 at T=32k for values generation drops
     logits, states = model.apply(params, prompt, method="prefill_last")
     first = sample_logits(logits, jax.random.fold_in(rng, 0), sample_cfg)
     done0 = jnp.zeros(first.shape, bool)
-
-    def body(carry, i):
-        token, states, t, done = carry
-        logits, states = model.apply(params, token, states, t, method="decode_step")
-        nxt = sample_logits(logits, jax.random.fold_in(rng, i + 1), sample_cfg)
-        if use_eos:
-            # emit EOS itself, pad everything after it
-            emitted = jnp.where(done, sample_cfg.pad_token, token)
-            done = done | (emitted == sample_cfg.eos_token)
-        else:
-            emitted = token
-        return (nxt, states, t + 1, done), emitted
-
+    body = partial(_decode_body, model, params, sample_cfg, rng)
     (_, _, _, _), tokens = jax.lax.scan(
         body,
         (first, states, jnp.int32(t0), done0),
@@ -105,6 +117,122 @@ def _generate_jit(
         length=max_new_tokens,
     )
     return jnp.moveaxis(tokens, 0, 1)  # [B, N]
+
+
+# -- chunked decode (serving) -------------------------------------------------
+# The serving layer (orion_tpu/serving/) decodes in bounded lax.scan chunks
+# instead of one monolithic scan: chunk boundaries are where deadlines are
+# enforced, decode state is snapshotted, the all-finite probe runs, and
+# SIGTERM/watchdog bookkeeping happens — none of which can live inside a
+# single N-step scan. The shared ``_decode_body`` keeps the chunked walk
+# bitwise-identical to ``generate()`` at the same rng.
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def _prefill_carry_jit(
+    model: TransformerLM,
+    params: Any,
+    tokens: Array,
+    sample_cfg: SampleConfig,
+    rng: Array,
+    sample_index: Array,
+    done: Array,
+) -> Tuple[Array, Any, Array, Array]:
+    logits, states = model.apply(params, tokens, method="prefill_last")
+    nxt = sample_logits(
+        logits, jax.random.fold_in(rng, sample_index), sample_cfg
+    )
+    return (nxt, states, jnp.int32(tokens.shape[1]), done)
+
+
+def prefill_carry(
+    model: TransformerLM,
+    params: Any,
+    tokens: Array,
+    sample_cfg: SampleConfig,
+    rng: Array,
+    sample_index: int = 0,
+    done: Optional[Array] = None,
+):
+    """tokens [B, T] -> the decode carry (next_token, states, t, done).
+
+    ``sample_index`` is the rng fold_in key for the first sampled token —
+    0 for a fresh prompt (matching ``generate()``), or ``n`` when
+    re-prefilling after ``n`` tokens were already emitted (the serving
+    degradation ladder's second rung)."""
+    tokens = jnp.asarray(tokens, jnp.int32)
+    if done is None:
+        done = jnp.zeros((tokens.shape[0],), bool)
+    return _prefill_carry_jit(
+        model, params, tokens, sample_cfg, rng, jnp.int32(sample_index), done
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 4, 5))
+def _decode_chunk_jit(
+    model: TransformerLM,
+    params: Any,
+    carry: Any,
+    rng: Array,
+    n_steps: int,
+    sample_cfg: SampleConfig,
+    start: Array,
+) -> Tuple[Any, Array]:
+    body = partial(_decode_body, model, params, sample_cfg, rng)
+    carry, tokens = jax.lax.scan(
+        body, carry, start + jnp.arange(n_steps), length=n_steps
+    )
+    return carry, jnp.moveaxis(tokens, 0, 1)  # [B, n_steps]
+
+
+def decode_chunk(
+    model: TransformerLM,
+    params: Any,
+    carry: Any,
+    rng: Array,
+    start: int,
+    n_steps: int,
+    sample_cfg: SampleConfig,
+):
+    """Advance the decode carry by ``n_steps`` tokens (one bounded scan).
+    ``start`` is the absolute index of the first token this chunk emits;
+    it rides in as a traced scalar so every chunk of a given length shares
+    ONE compile."""
+    return _decode_chunk_jit(
+        model, params, carry, rng, int(n_steps), sample_cfg,
+        jnp.int32(start),
+    )
+
+
+def generate_chunked(
+    model: TransformerLM,
+    params: Any,
+    prompt: Array,
+    max_new_tokens: int,
+    chunk: int = 16,
+    sample: Optional[SampleConfig] = None,
+    rng: Optional[Array] = None,
+) -> Array:
+    """``generate()`` decoded in ``chunk``-step scans — bitwise-identical
+    output at the same rng (the equivalence the chunked-decode tests pin).
+    The resilient serving path is :class:`orion_tpu.serving.DecodeSession`,
+    which adds snapshots, the finite-state probe, and the degradation
+    ladder around this same walk."""
+    assert chunk > 0, chunk
+    sample_cfg = sample or SampleConfig()
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    prompt = jnp.asarray(prompt, jnp.int32)
+    carry = prefill_carry(model, params, prompt, sample_cfg, rng)
+    out = []
+    n = 0
+    while n < max_new_tokens:
+        c = min(chunk, max_new_tokens - n)
+        carry, toks = decode_chunk(model, params, carry, rng, n, c, sample_cfg)
+        out.append(toks)
+        n += c
+    return jnp.concatenate(out, axis=1)
 
 
 def cast_params_for_inference(model: TransformerLM, params: Any) -> Any:
@@ -263,30 +391,144 @@ def generate_unconditional(
     return generate(model, params, prompt, max_new_tokens, **kw)
 
 
-def load_params(ckpt_dir: str, step: Optional[int] = None) -> Tuple[Any, int]:
-    """Pull just the params subtree out of a training checkpoint."""
+def _load_step_params(mngr, ckpt_dir: str, step: int, retry, verify: bool):
+    """Restore + manifest-verify ONE step's params (helper of
+    :func:`load_params`). I/O is retried (OSError-only, jittered backoff);
+    the ``serve.ckpt_load`` fault hook fires inside the retried region so
+    chaos tests drive the real path."""
+    import orbax.checkpoint as ocp
+
+    from orion_tpu.resilience.inject import fire
+    from orion_tpu.resilience.retry import call_with_retries
+    from orion_tpu.training.checkpoint import (
+        manifest_subtree,
+        read_manifest,
+        verify_manifest,
+    )
+
+    def _restore():
+        fire("serve.ckpt_load", step=step)
+        try:
+            return mngr.restore(step)
+        except KeyError:
+            # orbax versions that saved via StandardSave refuse a bare
+            # restore(step) ("provide a CheckpointHandlerRegistry or
+            # CheckpointArgs"); StandardRestore with no target restores the
+            # saved tree structure as-is
+            return mngr.restore(step, args=ocp.args.StandardRestore())
+
+    restored = call_with_retries(
+        _restore, retry, describe=f"serving param load (step {step})"
+    )
+    params = restored["params"]
+    if verify:
+        import warnings
+
+        manifest = read_manifest(ckpt_dir, step)
+        sub = None if manifest is None else manifest_subtree(manifest, ".params")
+        if sub is None:
+            warnings.warn(
+                f"checkpoint step {step} has no params integrity manifest "
+                "(pre-manifest checkpoint?); serving it unverified",
+                stacklevel=3,
+            )
+        else:
+            verify_manifest(params, sub)  # raises CheckpointIntegrityError
+    return params
+
+
+def load_params(
+    ckpt_dir: str,
+    step: Optional[int] = None,
+    retry: Optional[Any] = None,
+    verify: bool = True,
+) -> Tuple[Any, int]:
+    """Pull just the params subtree out of a training checkpoint — the
+    serving-side loader, hardened the same way the trainer's restore is
+    (training/checkpoint.py): orbax I/O retried with jittered backoff
+    (OSError-only), the restored params re-checksummed against the step's
+    integrity manifest, and a default-latest load FALLING BACK to the
+    newest intact retained step (loud warning) when the latest is torn or
+    corrupt, instead of taking the serving process down on its first
+    request. An explicitly pinned ``step`` never falls back — the caller
+    asked for exactly that step, so corruption there raises."""
     import os
+    import warnings
 
     import orbax.checkpoint as ocp
 
+    from orion_tpu.resilience.retry import RetryPolicy
+    from orion_tpu.training.checkpoint import CheckpointIntegrityError
+
+    policy = retry if retry is not None else RetryPolicy()
     # orbax requires absolute paths; the Trainer-side Checkpointer already
     # abspaths, this CLI-side loader must too ("--ckpt-dir ck" otherwise
     # dies deep in tensorstore)
-    mngr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
-    step = mngr.latest_step() if step is None else step
-    if step is None:
-        raise FileNotFoundError(f"no checkpoint in {ckpt_dir}")
+    root = os.path.abspath(ckpt_dir)
+    mngr = ocp.CheckpointManager(root)
     try:
-        restored = mngr.restore(step)
-    except KeyError:
-        # orbax versions that saved via StandardSave refuse a bare
-        # restore(step) ("provide a CheckpointHandlerRegistry or
-        # CheckpointArgs"); StandardRestore with no target restores the
-        # saved tree structure as-is
-        restored = mngr.restore(step, args=ocp.args.StandardRestore())
-    mngr.close()
-    params = restored["params"]
-    return params, step
+        if step is not None:
+            return _load_step_params(mngr, root, step, policy, verify), step
+        steps = sorted(mngr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint in {ckpt_dir}")
+        failures = []
+        for s in steps:
+            try:
+                params = _load_step_params(mngr, root, s, policy, verify)
+            except Exception as e:  # orbax corruption surfaces as many types
+                failures.append((s, e))
+                warnings.warn(
+                    f"checkpoint step {s} is corrupt or incomplete "
+                    f"({type(e).__name__}: {str(e)[:200]}); serving falls "
+                    "back to the next retained step",
+                    stacklevel=2,
+                )
+                continue
+            if failures:
+                warnings.warn(
+                    f"serving params from step {s} after skipping corrupt "
+                    f"step(s) {[f[0] for f in failures]}",
+                    stacklevel=2,
+                )
+            return params, s
+        raise CheckpointIntegrityError(
+            f"no intact checkpoint in {ckpt_dir}; tried "
+            + ", ".join(f"{s} ({type(e).__name__})" for s, e in failures)
+        ) from failures[-1][1]
+    finally:
+        mngr.close()
+
+
+def adapt_config_to_params(cfg: ModelConfig, params: Any) -> ModelConfig:
+    """Match a named config to the checkpoint's ACTUAL capacities — the
+    architecture must follow the checkpoint, not the config name:
+    train.py auto-bumps max_seq_len when seq_len >= max_seq_len (read the
+    real positional capacity off the stored pos_embed table), and
+    ``--set vocab_size=...`` runs change the embedding rows. Shared by
+    the generate / evaluate / serving CLIs so the adaptation can't drift
+    between them. Unknown layouts (quantized trees) pass through as-is."""
+    try:
+        pos_rows = params["params"]["pos_embed"]["embedding"].shape[0]
+        if pos_rows != cfg.max_seq_len:
+            cfg = dataclasses.replace(cfg, max_seq_len=pos_rows)
+        vocab = params["params"]["embed"]["embedding"].shape[0]
+        if vocab != cfg.vocab_size:
+            cfg = dataclasses.replace(cfg, vocab_size=vocab)
+    except (KeyError, TypeError):
+        pass
+    return cfg
+
+
+def unstack_if_pipeline(model: TransformerLM, params: Any) -> Tuple[Any, bool]:
+    """Convert a pipeline-trained checkpoint (stacked per-stage block
+    params) to the standard serving layout; no-op on standard
+    checkpoints. Returns (params, was_pipeline)."""
+    if "blocks_stacked" in params.get("params", {}):
+        from orion_tpu.parallel.pipeline_lm import unstack_lm_params
+
+        return unstack_lm_params(model, params), True
+    return params, False
 
 
 def main(argv=None) -> int:
@@ -313,6 +555,9 @@ def main(argv=None) -> int:
     p.add_argument("--quant", default="", choices=["", "int8", "int4"],
                    help="weight-streamed decode: int8 quarters the weight "
                         "HBM traffic, int4 halves it again (orion_tpu/quant.py)")
+    p.add_argument("--ckpt-attempts", type=int, default=4,
+                   help="total tries for the checkpoint load (transient "
+                        "I/O retried with jittered backoff; 1 = no retry)")
     # same mesh flags as train.py / aot.py; any axis > 1 builds a mesh
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--fsdp", type=int, default=1)
@@ -350,18 +595,16 @@ def main(argv=None) -> int:
     prompt = jnp.asarray([tok.encode(args.prompt)], jnp.int32)
 
     if args.ckpt_dir:
-        params, step = load_params(args.ckpt_dir)
-        # match the checkpoint's positional capacity (train.py auto-bump)
-        pos_rows = params["params"]["pos_embed"]["embedding"].shape[0]
-        if pos_rows != cfg.max_seq_len:
-            cfg = dataclasses.replace(cfg, max_seq_len=pos_rows)
+        from orion_tpu.resilience.retry import RetryPolicy
+
+        params, step = load_params(
+            args.ckpt_dir, retry=RetryPolicy(attempts=max(args.ckpt_attempts, 1))
+        )
+        cfg = adapt_config_to_params(cfg, params)
         print(f"loaded step {step} from {args.ckpt_dir}", file=sys.stderr)
         model = TransformerLM(cfg)
-        if "blocks_stacked" in params.get("params", {}):
-            # pipeline-trained checkpoint: convert to the standard layout
-            from orion_tpu.parallel.pipeline_lm import unstack_lm_params
-
-            params = unstack_lm_params(model, params)
+        params, was_pp = unstack_if_pipeline(model, params)
+        if was_pp:
             print("unstacked pipeline-layout checkpoint", file=sys.stderr)
     else:
         model = TransformerLM(cfg)

@@ -678,6 +678,34 @@ class TransformerLM(nn.Module):
         return self._head(x), new_states
 
 
+def snapshot_decode_state(states: List[State]) -> List[State]:
+    """O(1) snapshot of the per-layer decode state for the serving rewind
+    path (orion_tpu/serving/session.py). jax arrays are immutable, so a
+    snapshot only needs fresh *containers* — the rewind target must not see
+    dicts that a later chunk's bookkeeping mutated in place. No device copy
+    happens (the decode chunks never donate their state buffers)."""
+    return jax.tree.map(lambda x: x, states)
+
+
+@jax.jit
+def _all_finite(states: List[State]) -> Array:
+    acc = jnp.bool_(True)
+    for leaf in jax.tree.leaves(states):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            acc = jnp.logical_and(acc, jnp.all(jnp.isfinite(leaf)))
+    return acc
+
+
+def decode_state_finite(states: List[State]) -> Array:
+    """Cheap jitted all-finite probe over the (S, z)/KV/ring decode state:
+    one fused reduction per floating leaf, ANDed to a scalar bool on
+    device. Integer leaves (cache slot bookkeeping) are skipped. Returns
+    the DEVICE scalar — the caller decides where to sync it to host
+    (serving's designated probe point, see analysis rule
+    ``decode-host-sync``)."""
+    return _all_finite(states)
+
+
 def init_decode_state(
     cfg: ModelConfig, batch_size: int, dtype: Any = None
 ) -> List[State]:
@@ -707,4 +735,7 @@ def init_decode_state(
     return states
 
 
-__all__ = ["TransformerLM", "Attention", "Block", "MLP", "init_decode_state"]
+__all__ = [
+    "TransformerLM", "Attention", "Block", "MLP", "init_decode_state",
+    "snapshot_decode_state", "decode_state_finite",
+]

@@ -8,7 +8,8 @@ that proves it by injecting them:
 
 - :mod:`inject`   — deterministic, test-controlled fault delivery at named
   production hook points (checkpoint/data I/O errors, NaN gradient
-  poisoning, simulated preemption) plus checkpoint corruption helpers.
+  poisoning, simulated preemption, decode-state NaNs and mid-request
+  SIGTERM on the serving side) plus checkpoint corruption helpers.
 - :mod:`retry`    — jittered exponential backoff for transient I/O, with
   injectable sleep/rng so tests run in milliseconds.
 - :mod:`watchdog` — heartbeat stall detection (:class:`StallError`) for
@@ -17,7 +18,7 @@ that proves it by injecting them:
   boundary, emergency checkpoint, resumable exit.
 
 Import direction: this package depends only on the stdlib (+numpy at the
-edges); ``training/`` imports it, never the reverse.
+edges); ``training/`` and ``serving/`` import it, never the reverse.
 """
 
 # NOTE: `inject` stays bound to the SUBMODULE (inject.inject/fire/nan_armed

@@ -21,6 +21,19 @@ Hook sites wired today:
                           the graceful-stop path end to end)
 ``"train.nan"``           consumed via :func:`nan_armed` by ``Trainer.step``
                           to poison one step's gradients to NaN
+``"serve.ckpt_load"``     generate.load_params, inside the retry region —
+                          serving-side checkpoint restore
+``"serve.tokenizer_io"``  serving/server.py tokenizer load, inside the retry
+                          region
+``"serve.chunk"``         serving/session.py DecodeSession, at each decode
+                          chunk boundary (step = the request's chunk index)
+                          — where :meth:`FaultPlan.preempt_at_chunk`
+                          delivers a real SIGTERM mid-request
+``"decode.state_nan"``    consumed via :func:`decode_nan_armed` by
+                          DecodeSession to poison one chunk's (S, z)/KV
+                          decode state to NaN — each rung of the serving
+                          degradation ladder is reached by arming 1, 2, or
+                          unlimited deliveries at the same chunk
 ========================  ====================================================
 
 Also here: :func:`corrupt_step` / :func:`truncate_step`, which damage a
@@ -39,6 +52,8 @@ import threading
 from typing import Callable, List, Optional
 
 _NAN_SITE = "train.nan"
+_DECODE_NAN_SITE = "decode.state_nan"
+_CHUNK_SITE = "serve.chunk"
 
 
 @dataclasses.dataclass
@@ -99,6 +114,24 @@ class FaultPlan:
         """Arm a NaN-gradient poisoning for one training step (consumed by
         ``Trainer.step`` via :func:`nan_armed`)."""
         return self.add(_NAN_SITE, step, 1, None)
+
+    def preempt_at_chunk(self, chunk: int, sig: int = signal.SIGTERM) -> "FaultPlan":
+        """Deliver a real OS signal at a serving request's decode-chunk
+        boundary. With the Server's PreemptionGuard installed this drives
+        the DRAINING path end to end: the in-flight request completes, new
+        requests are rejected, the process exits 0."""
+        return self.add(
+            _CHUNK_SITE, chunk, 1, lambda: signal.raise_signal(sig)
+        )
+
+    def poison_decode_state_at(self, chunk: int, times: int = 1) -> "FaultPlan":
+        """Arm NaN-poisoning of the decode state at a chunk boundary
+        (consumed by serving's DecodeSession via :func:`decode_nan_armed`
+        after each attempt at that chunk). ``times=1`` exercises the
+        rewind rung of the degradation ladder, ``times=2`` forces the
+        re-prefill rung, ``times<0`` (unlimited) exhausts the ladder and
+        fails the request — never the process."""
+        return self.add(_DECODE_NAN_SITE, chunk, times, None)
 
     # -- delivery ------------------------------------------------------------
 
@@ -162,6 +195,15 @@ def nan_armed(step: int) -> bool:
     return plan is not None and plan.consume_marker(_NAN_SITE, step)
 
 
+def decode_nan_armed(chunk: int) -> bool:
+    """Is a decode-state NaN-poisoning armed for this chunk? Consumes one
+    delivery — the DecodeSession asks again after every ladder rung's
+    retry of the same chunk, so multi-delivery plans poison each attempt
+    in turn."""
+    plan = _active
+    return plan is not None and plan.consume_marker(_DECODE_NAN_SITE, chunk)
+
+
 # -- on-disk checkpoint corruption (test control, not a hook) -----------------
 
 
@@ -205,5 +247,5 @@ def truncate_step(ckpt_dir: str, step: int) -> List[str]:
 
 __all__ = [
     "FaultPlan", "inject", "active", "fire", "nan_armed",
-    "corrupt_step", "truncate_step",
+    "decode_nan_armed", "corrupt_step", "truncate_step",
 ]

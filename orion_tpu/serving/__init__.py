@@ -1,0 +1,39 @@
+"""Serving resilience: the inference-side counterpart of the training
+resilience stack (orion_tpu/resilience/, PR 2).
+
+- :mod:`session` — :class:`DecodeSession`: chunked decode with per-chunk
+  state snapshots, a jitted all-finite probe, a rewind -> re-prefill ->
+  fail-request degradation ladder, and chunk-granular deadlines.
+- :mod:`server`  — :class:`Server`: bounded admission with explicit
+  shed-on-overload, per-request isolation, watchdog heartbeats, and
+  SIGTERM -> drain (finish in-flight, reject new, exit 0).
+- :mod:`health`  — the validated STARTING -> SERVING <-> DEGRADED ->
+  DRAINING -> DEAD process health state machine.
+
+``python -m orion_tpu.serving`` is the CLI (``--deadline-ms``,
+``--max-inflight``, ``--chunk``; see README "Resilient serving"). The
+chaos coverage lives in tests/test_serving.py under the ``chaos`` marker.
+"""
+
+from orion_tpu.serving.health import Health, HealthMachine, InvalidTransition
+from orion_tpu.serving.server import (
+    OverloadError,
+    Pending,
+    RejectedError,
+    ServeConfig,
+    Server,
+    load_tokenizer,
+)
+from orion_tpu.serving.session import (
+    DecodeRequest,
+    DecodeResult,
+    DecodeSession,
+    LadderExhausted,
+)
+
+__all__ = [
+    "Health", "HealthMachine", "InvalidTransition",
+    "Server", "ServeConfig", "Pending", "OverloadError", "RejectedError",
+    "load_tokenizer",
+    "DecodeRequest", "DecodeResult", "DecodeSession", "LadderExhausted",
+]

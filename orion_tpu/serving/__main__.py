@@ -1,0 +1,198 @@
+"""``python -m orion_tpu.serving`` — resilient batch serving CLI.
+
+Reads prompts (one per line, ``--prompts-file`` or stdin), submits them
+through the bounded admission queue, and drains in waves: when the queue
+fills, the loop serves until idle and resumes submitting — so a prompt
+file larger than ``--max-inflight`` still completes while overload
+shedding stays observable (``--no-wave`` sheds instead). SIGTERM at any
+point drains gracefully: in-flight requests finish, the rest are
+rejected, exit code 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.generate import (
+    SampleConfig,
+    adapt_config_to_params,
+    load_params,
+    unstack_if_pipeline,
+)
+from orion_tpu.models.configs import get_config
+from orion_tpu.models.transformer import TransformerLM
+from orion_tpu.resilience.preempt import PreemptionGuard
+from orion_tpu.resilience.retry import RetryPolicy
+from orion_tpu.serving.health import Health
+from orion_tpu.serving.server import (
+    OverloadError,
+    RejectedError,
+    ServeConfig,
+    Server,
+    load_tokenizer,
+)
+from orion_tpu.serving.session import DecodeRequest
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("orion_tpu.serving")
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--prompts-file", default="-",
+                   help="one prompt per line; '-' = stdin")
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--chunk", type=int, default=16,
+                   help="decode chunk length: the deadline / snapshot / "
+                        "drain granularity")
+    p.add_argument("--deadline-ms", type=float, default=0.0,
+                   help="per-request deadline, enforced at chunk "
+                        "boundaries (0 = none)")
+    p.add_argument("--max-inflight", type=int, default=8,
+                   help="admission bound; a full queue sheds "
+                        "(OverloadError) instead of queueing unboundedly")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   help="watchdog heartbeat budget per decode chunk "
+                        "(0 = off); must exceed compile + one chunk")
+    p.add_argument("--grace", type=float, default=30.0,
+                   help="SIGTERM drain budget (seconds)")
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tokenizer", default=None,
+                   help="BPE tokenizer JSON; default byte-level")
+    p.add_argument("--eos", action="store_true",
+                   help="stop sequences at the tokenizer's <eos>")
+    p.add_argument("--ckpt-attempts", type=int, default=4)
+    p.add_argument("--no-wave", action="store_true",
+                   help="don't drain-and-resume on overload: shed excess "
+                        "prompts (reported on stderr)")
+    p.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="ModelConfig override (must match the checkpoint)",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    from orion_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+    args = build_argparser().parse_args(argv)
+    # ONE guard spans the whole lifecycle — startup, submission, every
+    # serve wave — so SIGTERM during model load or between waves maps to
+    # a graceful drain (exit 0) too, not just mid-decode; Server.serve
+    # polls this guard instead of installing its own
+    with PreemptionGuard(grace=args.grace) as guard:
+        return _run(args, guard)
+
+
+def _run(args, guard) -> int:
+    retry = RetryPolicy(attempts=max(args.ckpt_attempts, 1))
+
+    cfg = get_config(args.config)
+    if args.set:
+        from orion_tpu.utils.config import apply_overrides, parse_set_overrides
+
+        cfg = apply_overrides(cfg, parse_set_overrides(args.set))
+    tok = load_tokenizer(args.tokenizer, retry=retry)
+    eos_token = -1
+    if args.tokenizer and args.eos:
+        eos_token = tok.eos
+
+    if args.ckpt_dir:
+        params, step = load_params(args.ckpt_dir, retry=retry)
+        cfg = adapt_config_to_params(cfg, params)
+        print(f"serving step {step} from {args.ckpt_dir}", file=sys.stderr)
+        model = TransformerLM(cfg)
+        params, _ = unstack_if_pipeline(model, params)
+    else:
+        model = TransformerLM(cfg)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )
+        print("no --ckpt-dir: random params (smoke test)", file=sys.stderr)
+    if args.tokenizer:
+        # after cfg adaptation: out-of-vocab ids would be silently clamped
+        # by the embedding gather — garbage served with status 'ok'
+        assert tok.vocab_size <= cfg.vocab_size, (
+            f"tokenizer vocab {tok.vocab_size} > model vocab {cfg.vocab_size}"
+        )
+
+    if args.prompts_file == "-":
+        lines = [ln.rstrip("\n") for ln in sys.stdin]
+    else:
+        with open(args.prompts_file) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+    lines = [ln for ln in lines if ln]
+
+    sample = SampleConfig(
+        args.temperature, args.top_k, args.top_p, eos_token=eos_token
+    )
+    server = Server(
+        model, params,
+        ServeConfig(
+            chunk=args.chunk, max_inflight=args.max_inflight,
+            deadline_ms=args.deadline_ms, stall_timeout=args.stall_timeout,
+            grace=args.grace,
+        ),
+    )
+    completed = []  # (prompt, Pending) in submission order
+    rc = 0
+    for i, line in enumerate(lines):
+        if guard.should_stop:
+            print(f"draining on signal: {len(lines) - i} prompt(s) not "
+                  "submitted", file=sys.stderr)
+            break
+        req = DecodeRequest(
+            prompt=jnp.asarray([tok.encode(line)], jnp.int32),
+            max_new_tokens=args.max_new_tokens,
+            sample=sample,
+            seed=args.seed + i,
+        )
+        try:
+            completed.append((line, server.submit(req)))
+        except OverloadError:
+            if args.no_wave:
+                print(f"shed (overload): {line!r}", file=sys.stderr)
+                continue
+            rc = server.serve(drain_when_idle=True, guard=guard)
+            if server.health.state is Health.DEAD:
+                # drained on a signal mid-wave: the overflow prompt and
+                # everything after it were never submitted — say so, an
+                # exit-0 run must not silently be incomplete
+                print(f"draining on signal: {len(lines) - i} prompt(s) "
+                      "not submitted", file=sys.stderr)
+                break
+            completed.append((line, server.submit(req)))
+        except RejectedError:
+            print(f"rejected ({server.health.state.value}): {line!r}",
+                  file=sys.stderr)
+            break
+        if server.health.state is Health.DEAD:
+            break
+    if server.health.state is not Health.DEAD:
+        rc = server.serve(drain_when_idle=True, guard=guard)
+        server.close()
+
+    for line, pending in completed:
+        r = pending.result
+        if r is None:
+            why = type(pending.error).__name__ if pending.error else "dropped"
+            print(f"[{why}] {line}", file=sys.stderr)
+            continue
+        ids = [int(t) for t in r.tokens[0]]
+        if eos_token >= 0 and eos_token in ids:
+            ids = ids[: ids.index(eos_token)]
+        tag = "" if r.status == "ok" else f" [{r.status}]"
+        print(line + tok.decode(ids) + tag)
+    print(f"stats: {server.stats}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+"""Process health state machine for the serving layer.
+
+A serving process is never just "up" or "down": it boots (compiles,
+loads params), serves, limps (a request needed the degradation ladder, a
+watchdog tripped), drains on SIGTERM (finish in-flight, reject new), and
+dies. Load balancers and schedulers need that word, not a log grep — and
+the transitions need to be VALIDATED, because the signal path and the
+serve loop both drive them concurrently and an illegal edge (a draining
+process re-entering service, a dead one accepting work) is exactly the
+kind of bug that only fires during an incident.
+
+::
+
+    STARTING ──> SERVING <──> DEGRADED
+        │           │             │
+        └───────> DRAINING <──────┘
+                    │
+                    v          (every state may also jump straight
+                   DEAD         to DRAINING or DEAD on fatal errors)
+
+DRAINING is absorbing except into DEAD: once a stop was requested there
+is no path back to accepting traffic. ``accepting`` is the admission-
+control gate — DEGRADED still serves (the ladder recovered the request;
+shedding a limping-but-correct replica is the balancer's call, made on
+the reported state, not ours).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+class Health(enum.Enum):
+    STARTING = "starting"
+    SERVING = "serving"
+    DEGRADED = "degraded"
+    DRAINING = "draining"
+    DEAD = "dead"
+
+
+_ALLOWED = {
+    Health.STARTING: {Health.SERVING, Health.DRAINING, Health.DEAD},
+    Health.SERVING: {Health.DEGRADED, Health.DRAINING, Health.DEAD},
+    Health.DEGRADED: {Health.SERVING, Health.DRAINING, Health.DEAD},
+    Health.DRAINING: {Health.DEAD},
+    Health.DEAD: set(),
+}
+
+
+class InvalidTransition(RuntimeError):
+    """An illegal health edge was requested (e.g. DRAINING -> SERVING)."""
+
+
+class HealthMachine:
+    """Validated, thread-safe health transitions with a timestamped
+    history (the post-mortem artifact: *when* did we degrade, *what*
+    said so)."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable[[Health, Health, str], None]] = None,
+    ):
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = Health.STARTING
+        self._since = clock()
+        self.history: List[Tuple[Optional[Health], Health, str, float]] = [
+            (None, Health.STARTING, "init", self._since)
+        ]
+
+    @property
+    def state(self) -> Health:
+        return self._state
+
+    @property
+    def accepting(self) -> bool:
+        """May new requests be admitted? DEGRADED still serves; STARTING
+        queues work for the serve loop to pick up once ready."""
+        return self._state in (Health.STARTING, Health.SERVING, Health.DEGRADED)
+
+    def to(self, new: Health, reason: str = "") -> bool:
+        """Transition to ``new``; returns False for an idempotent
+        same-state request, raises :class:`InvalidTransition` on an
+        illegal edge. The reason string is recorded — transitions without
+        a why are useless in a post-mortem."""
+        with self._lock:
+            old = self._state
+            if new is old:
+                return False
+            if new not in _ALLOWED[old]:
+                raise InvalidTransition(
+                    f"health: illegal transition {old.value} -> {new.value}"
+                    f" ({reason or 'no reason given'})"
+                )
+            self._state = new
+            self._since = self._clock()
+            self.history.append((old, new, reason, self._since))
+        if self._on_transition is not None:
+            self._on_transition(old, new, reason)
+        return True
+
+    def snapshot(self) -> dict:
+        """The /healthz payload: current state, how long we've been in
+        it, and the full transition history."""
+        with self._lock:
+            return {
+                "state": self._state.value,
+                "accepting": self.accepting,
+                "in_state_secs": self._clock() - self._since,
+                "transitions": [
+                    {
+                        "from": a.value if a else None,
+                        "to": b.value,
+                        "reason": r,
+                        "at": t,
+                    }
+                    for a, b, r, t in self.history
+                ],
+            }
+
+
+__all__ = ["Health", "HealthMachine", "InvalidTransition"]

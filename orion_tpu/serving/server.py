@@ -1,0 +1,319 @@
+"""Bounded-admission request server over a DecodeSession.
+
+The serving counterpart of the trainer's resilience stack (PR 2): the
+same primitives — PreemptionGuard, Watchdog, retry, fault hooks — wired
+around the decode path instead of the step loop.
+
+- **admission** — a bounded queue (``max_inflight``); a full queue SHEDS
+  the request with :class:`OverloadError` at submit time instead of
+  growing an unbounded backlog whose tail latency is all deadline misses
+  anyway. A draining/dead server REJECTS with :class:`RejectedError`.
+- **health** — the :class:`~orion_tpu.serving.health.HealthMachine`
+  drives admission: SERVING/DEGRADED accept, DRAINING/DEAD reject.
+  Requests that needed the degradation ladder (or a watchdog stall) move
+  SERVING -> DEGRADED; a clean completion recovers to SERVING.
+- **SIGTERM** — the PreemptionGuard installed around the serve loop maps
+  the first signal to DRAINING at the next chunk boundary: in-flight and
+  already-admitted requests complete, new submits are rejected, the loop
+  exits 0. A second signal kills, as everywhere else in the stack.
+- **watchdog** — ``stall_timeout`` arms a heartbeat watchdog beaten at
+  every chunk boundary; a stalled chunk (wedged DMA, deadlocked
+  collective) degrades health and writes a diagnosis instead of hanging
+  the replica silently.
+- **request isolation** — a request that raises is recorded on its
+  Pending and counted; the process never dies for one request.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import queue
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from orion_tpu.resilience.inject import fire
+from orion_tpu.resilience.preempt import PreemptionGuard
+from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
+from orion_tpu.resilience.watchdog import Watchdog
+from orion_tpu.serving.health import Health, HealthMachine
+from orion_tpu.serving.session import (
+    DecodeRequest,
+    DecodeResult,
+    DecodeSession,
+)
+
+
+class OverloadError(RuntimeError):
+    """Admission queue full: the request was shed, not queued."""
+
+
+class RejectedError(RuntimeError):
+    """The server is draining or dead and accepts no new requests."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    chunk: int = 16  # decode chunk length (deadline/abort granularity)
+    max_inflight: int = 8  # admission bound: queued + running requests
+    deadline_ms: float = 0.0  # default per-request deadline (0 = none)
+    stall_timeout: float = 0.0  # watchdog heartbeat budget (0 = off)
+    grace: float = 30.0  # SIGTERM drain budget, as in training
+    poll: float = 0.05  # idle queue poll cadence (seconds)
+
+
+@dataclasses.dataclass
+class Pending:
+    """A submitted request's slot; ``done`` is set exactly once, with
+    either ``result`` or ``error`` filled. ``admitted_at`` anchors the
+    request's deadline: queue wait counts against the budget."""
+
+    request: DecodeRequest
+    done: threading.Event
+    admitted_at: float = 0.0
+    result: Optional[DecodeResult] = None
+    error: Optional[Exception] = None
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[DecodeResult]:
+        """Block for the outcome: returns the DecodeResult, RAISES the
+        request's recorded error (rejection at shutdown, a raising
+        request), or returns None only on timeout — so a dropped request
+        can't be mistaken for a slow one."""
+        if not self.done.wait(timeout=timeout):
+            return None
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+def load_tokenizer(path: Optional[str] = None, retry: Optional[RetryPolicy] = None):
+    """Tokenizer I/O behind the same jittered-backoff retry as the
+    checkpoint load — a 2-second storage blip on the tokenizer JSON must
+    not kill a replica that survived everything else. ``None`` path =
+    the byte-level tokenizer (no I/O beyond the hook)."""
+
+    def _load():
+        fire("serve.tokenizer_io")
+        if path:
+            from orion_tpu.utils.bpe import BPETokenizer
+
+            return BPETokenizer.load(path)
+        from orion_tpu.utils.tokenizer import ByteTokenizer
+
+        return ByteTokenizer()
+
+    return call_with_retries(
+        _load, retry if retry is not None else RetryPolicy(),
+        describe="tokenizer load",
+    )
+
+
+class Server:
+    """Single-worker serve loop (decode serializes on the device anyway);
+    ``submit`` is thread-safe and may be called from feeder threads."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        cfg: ServeConfig = ServeConfig(),
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = cfg
+        self._clock = clock
+        self.session = DecodeSession(
+            model, params, chunk=cfg.chunk, clock=clock
+        )
+        self.health = HealthMachine(clock=clock)
+        self._q: "queue.Queue[Pending]" = queue.Queue(maxsize=cfg.max_inflight)
+        self._guard: Optional[PreemptionGuard] = None
+        # submit() is documented thread-safe for feeder threads. The
+        # admission lock makes (accepting check -> enqueue) atomic against
+        # the drain path's final (reject leftovers -> DEAD): without it a
+        # put landing between the serve loop's last empty-check and DEAD
+        # would strand a Pending whose done event never fires.
+        self._admission_lock = threading.Lock()
+        # ...and the dict read-modify-writes below race without their own
+        self._stats_lock = threading.Lock()
+        self.stats: Dict[str, int] = {
+            "admitted": 0, "shed": 0, "rejected": 0,
+            "ok": 0, "deadline": 0, "failed": 0,
+            "rewinds": 0, "reprefills": 0, "stalls": 0,
+        }
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
+    # -- admission ------------------------------------------------------------
+
+    def submit(self, request: DecodeRequest) -> Pending:
+        """Admit a request or refuse loudly: RejectedError when draining/
+        dead, OverloadError when the bounded queue is full (shed — the
+        caller retries elsewhere; an unbounded backlog would just convert
+        overload into deadline misses later)."""
+        if request.deadline_ms <= 0 and self.cfg.deadline_ms > 0:
+            request = dataclasses.replace(
+                request, deadline_ms=self.cfg.deadline_ms
+            )
+        pending = Pending(
+            request, threading.Event(), admitted_at=self._clock()
+        )
+        with self._admission_lock:
+            if not self.health.accepting:
+                self._bump("rejected")
+                raise RejectedError(f"server is {self.health.state.value}")
+            try:
+                self._q.put_nowait(pending)
+            except queue.Full:
+                self._bump("shed")
+                raise OverloadError(
+                    f"admission queue full ({self.cfg.max_inflight} in flight)"
+                ) from None
+        self._bump("admitted")
+        return pending
+
+    # -- serve loop -----------------------------------------------------------
+
+    def serve(
+        self,
+        drain_when_idle: bool = False,
+        guard: Optional[PreemptionGuard] = None,
+    ) -> int:
+        """Run the serve loop. Returns 0 on a graceful exit: either a
+        SIGTERM-initiated drain completed (health ends DEAD) or
+        ``drain_when_idle`` found the queue empty (health stays SERVING —
+        callers may submit and serve again; ``close()`` finalizes).
+
+        ``guard``: an already-installed PreemptionGuard to poll instead of
+        installing one per serve() call — the CLI passes its whole-
+        lifecycle guard so a SIGTERM during submission (between waves)
+        still maps to a drain instead of the default kill."""
+        cfg = self.cfg
+        wd = None
+        if cfg.stall_timeout > 0:
+            wd = Watchdog(
+                cfg.stall_timeout, on_stall=self._on_stall, monitor=True,
+                label="serve loop",
+            )
+        with contextlib.ExitStack() as stack:
+            if guard is None:
+                guard = stack.enter_context(
+                    PreemptionGuard(grace=cfg.grace, clock=self._clock)
+                )
+            self._guard = guard
+            if self.health.state is Health.STARTING:
+                self.health.to(Health.SERVING, "serve loop running")
+            try:
+                while True:
+                    self._maybe_drain(guard)
+                    draining = self.health.state is Health.DRAINING
+                    if draining and self._q.empty():
+                        break
+                    try:
+                        pending = self._q.get(timeout=cfg.poll)
+                    except queue.Empty:
+                        if drain_when_idle:
+                            break
+                        continue
+                    self._run_one(pending, wd, guard)
+            finally:
+                if wd is not None:
+                    wd.close()
+                self._guard = None
+                # under the admission lock: once DEAD is published, no
+                # submit can slip a Pending into the dead queue (and any
+                # that landed between the loop's last empty-check and
+                # here is rejected, its done event set)
+                with self._admission_lock:
+                    self._maybe_drain(guard)
+                    if self.health.state is Health.DRAINING:
+                        self._reject_leftovers()
+                        self.health.to(Health.DEAD, "drained")
+        return 0
+
+    def close(self) -> None:
+        """Finalize a server whose loop exited idle: reject anything still
+        queued and go DEAD."""
+        with self._admission_lock:
+            self._reject_leftovers()
+            if self.health.state is not Health.DEAD:
+                self.health.to(Health.DEAD, "closed")
+
+    # -- internals ------------------------------------------------------------
+
+    def _run_one(self, pending: Pending, wd, guard) -> None:
+        if wd is not None:
+            wd.beat("request start")
+
+        def on_chunk(chunk_idx: int) -> None:
+            if wd is not None:
+                wd.beat("decode chunk")
+            self._maybe_drain(guard)
+
+        deadline_at = (
+            pending.admitted_at + pending.request.deadline_ms / 1000.0
+            if pending.request.deadline_ms > 0
+            else None
+        )
+        try:
+            result = self.session.run(
+                pending.request, on_chunk=on_chunk, deadline_at=deadline_at
+            )
+        except Exception as e:
+            # request isolation: a raising request is an error RESULT,
+            # never a dead process
+            pending.error = e
+            self._bump("failed")
+            self._degrade(f"request raised {type(e).__name__}: {e}")
+        else:
+            pending.result = result
+            self._bump(result.status)
+            self._bump("rewinds", result.rewinds)
+            self._bump("reprefills", result.reprefills)
+            if result.status == "failed" or result.degraded:
+                self._degrade(
+                    f"request needed the ladder (rewinds={result.rewinds}, "
+                    f"reprefills={result.reprefills}, status={result.status})"
+                )
+            elif self.health.state is Health.DEGRADED:
+                self.health.to(Health.SERVING, "clean request completed")
+        finally:
+            pending.done.set()
+
+    def _maybe_drain(self, guard) -> None:
+        if guard is not None and guard.should_stop and self.health.state in (
+            Health.STARTING, Health.SERVING, Health.DEGRADED
+        ):
+            self.health.to(
+                Health.DRAINING,
+                f"signal {guard.signum}: finish in-flight, reject new",
+            )
+
+    def _degrade(self, reason: str) -> None:
+        if self.health.state is Health.SERVING:
+            self.health.to(Health.DEGRADED, reason)
+
+    def _on_stall(self, diag: str) -> None:
+        # watchdog monitor thread, NOT a signal handler: buffered io is fine
+        self._bump("stalls")
+        sys.stderr.write(f"[serve] {diag}\n")
+        self._degrade(f"watchdog: {diag}")
+
+    def _reject_leftovers(self) -> None:
+        while True:
+            try:
+                pending = self._q.get_nowait()
+            except queue.Empty:
+                return
+            pending.error = RejectedError("server shut down before execution")
+            self._bump("rejected")
+            pending.done.set()
+
+
+__all__ = [
+    "Server", "ServeConfig", "Pending", "OverloadError", "RejectedError",
+    "load_tokenizer",
+]

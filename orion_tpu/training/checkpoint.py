@@ -110,6 +110,48 @@ def verify_manifest(state: Any, manifest: Dict[str, Any]) -> None:
         )
 
 
+def read_manifest(directory: str, step: int) -> Optional[Dict[str, Any]]:
+    """Standalone manifest reader (the serving-side loader,
+    ``generate.load_params``, has no Checkpointer): ``None`` when the step
+    has no manifest, :class:`CheckpointIntegrityError` when it exists but
+    is unreadable/corrupt JSON — an unreadable manifest is itself evidence
+    of a damaged step, not a license to skip verification."""
+    path = os.path.join(
+        os.path.abspath(directory), MANIFEST_DIRNAME, f"manifest-{step}.json"
+    )
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointIntegrityError(
+            f"step {step}: manifest unreadable ({e})"
+        ) from e
+
+
+def manifest_subtree(
+    manifest: Dict[str, Any], prefix: str = ".params"
+) -> Optional[Dict[str, Any]]:
+    """Project a full-TrainState manifest onto one attribute subtree,
+    re-rooting the leaf paths so the subtree restored STANDALONE (a plain
+    nested dict, the way ``load_params`` gets it back from orbax) verifies
+    against it. TrainState is a struct.PyTreeNode, so its manifest paths
+    read ``.params['params'][...]`` while a bare-dict restore flattens to
+    ``['params'][...]`` — stripping the attribute prefix aligns the two.
+    Returns ``None`` when the manifest has no leaves under ``prefix``
+    (unknown layout: caller should warn and serve unverified rather than
+    fail a healthy checkpoint)."""
+    leaves = [
+        dict(e, path=e["path"][len(prefix):])
+        for e in manifest.get("leaves", ())
+        if e["path"].startswith(prefix + "[")
+    ]
+    if not leaves:
+        return None
+    return {**manifest, "leaves": leaves, "n_leaves": len(leaves)}
+
+
 class Checkpointer:
     def __init__(
         self,
@@ -292,16 +334,7 @@ class Checkpointer:
         return state
 
     def _read_manifest(self, step: int) -> Optional[Dict[str, Any]]:
-        path = self._manifest_path(step)
-        if not os.path.exists(path):
-            return None
-        try:
-            with open(path) as f:
-                return json.load(f)
-        except (OSError, ValueError) as e:
-            raise CheckpointIntegrityError(
-                f"step {step}: manifest unreadable ({e})"
-            ) from e
+        return read_manifest(self.directory, step)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -325,5 +358,5 @@ def abstract_like(state: Any) -> Any:
 
 __all__ = [
     "Checkpointer", "CheckpointIntegrityError", "abstract_like",
-    "build_manifest", "verify_manifest",
+    "build_manifest", "verify_manifest", "read_manifest", "manifest_subtree",
 ]

@@ -302,6 +302,36 @@ def padded_entry(x, chunk):
     return pl.pallas_call(_kernel, out_shape=x)(x)
 """,
     ),
+    (
+        "decode-host-sync",
+        "orion_tpu/serving/dummy.py",
+        """
+import numpy as np
+
+def serve_loop(chunks):
+    outs = []
+    while chunks:
+        c = chunks.pop()
+        c.block_until_ready()
+        outs.append(np.asarray(c))
+        lat = float(c[0])
+    return outs
+""",
+        """
+import numpy as np
+
+def _probe_finite(state):
+    return float(state.sum())  # designated probe: the sanctioned sync
+
+def serve_loop(chunks):
+    outs = []
+    for c in chunks:
+        if not _probe_finite(c):
+            break
+        outs.append(c)
+    return np.asarray(outs)  # one sync AFTER the loop
+""",
+    ),
 ]
 
 
@@ -339,6 +369,37 @@ def poll(worker):
     )
     assert "unbounded-wait" in rule_ids(
         lint_source(src, path="orion_tpu/training/dummy.py")
+    )
+
+
+def test_decode_host_sync_scoped_to_decode_modules():
+    src = """
+def drive(chunks):
+    for c in chunks:
+        c.block_until_ready()
+"""
+    # decode modules: serving/ and generate.py
+    assert "decode-host-sync" in rule_ids(
+        lint_source(src, path="orion_tpu/serving/session.py")
+    )
+    assert "decode-host-sync" in rule_ids(
+        lint_source(src, path="orion_tpu/generate.py")
+    )
+    # host loops elsewhere (eval CLI, data prep) may sync freely
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(src, path="orion_tpu/evaluate.py")
+    )
+    # probe-named functions are the designated sync points — even a loop
+    # lexically inside one is exempt
+    probed = """
+def _probe_all_finite(carries):
+    for c in carries:
+        if not float(c.sum()):
+            return False
+    return True
+"""
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(probed, path="orion_tpu/serving/session.py")
     )
 
 

@@ -86,6 +86,42 @@ def test_greedy_is_argmax():
     np.testing.assert_array_equal(np.asarray(t), np.argmax(np.asarray(logits), -1))
 
 
+def test_greedy_ignores_filters():
+    """temperature=0 with top_k/top_p set is still exact argmax (the
+    filters are no-ops on a greedy request, not a crash or a bias)."""
+    logits = jax.random.normal(jax.random.PRNGKey(4), (3, 17))
+    t = sample_logits(
+        logits, jax.random.PRNGKey(5),
+        SampleConfig(temperature=0.0, top_k=3, top_p=0.5),
+    )
+    np.testing.assert_array_equal(np.asarray(t), np.argmax(np.asarray(logits), -1))
+
+
+def test_top_k_ge_vocab_is_no_filter():
+    """top_k >= V must not index out of range — it means 'no filtering',
+    bitwise-identical to top_k off at the same rng."""
+    logits = jax.random.normal(jax.random.PRNGKey(6), (4, 7))
+    rng = jax.random.PRNGKey(7)
+    for k in (7, 8, 100):
+        got = sample_logits(logits, rng, SampleConfig(temperature=1.0, top_k=k))
+        ref = sample_logits(logits, rng, SampleConfig(temperature=1.0, top_k=0))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_top_p_degenerate_keeps_argmax():
+    """A top_p cutoff that would mask every candidate (top_p <= 0, or
+    smaller than the argmax's own probability) keeps the argmax instead
+    of sampling from an all--inf row."""
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0, 4.0]] * 4)
+    for p in (0.0, 1e-9, 1e-3):
+        for i in range(10):
+            t = sample_logits(
+                logits, jax.random.fold_in(jax.random.PRNGKey(8), i),
+                SampleConfig(temperature=1.0, top_p=p),
+            )
+            assert set(np.asarray(t).tolist()) == {4}, p
+
+
 def test_long_decode_past_window():
     """Decode far beyond the swa window and the softmax cache warm region."""
     cfg = dataclasses.replace(CFG, max_seq_len=48)
@@ -141,6 +177,53 @@ def test_eos_stops_and_pads():
     # tokens before EOS are unchanged vs the no-EOS run
     np.testing.assert_array_equal(row[: first_eos + 1],
                                   np.asarray(base[0])[: first_eos + 1])
+
+
+def test_eos_pads_rows_independently():
+    """EOS hit mid-batch: each row pads after ITS OWN first EOS while the
+    other rows keep decoding unchanged."""
+    model, params = _model_and_params()
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (3, 4), 0, CFG.vocab_size)
+    base = np.asarray(generate(model, params, prompt, 8, SampleConfig(temperature=0.0)))
+    eos = int(base[0, 2])  # row 0's greedy token at step 2
+    out = np.asarray(generate(
+        model, params, prompt, 8,
+        SampleConfig(temperature=0.0, eos_token=eos, pad_token=0),
+    ))
+    for b in range(3):
+        hits = np.where(base[b] == eos)[0]
+        if len(hits) == 0:
+            np.testing.assert_array_equal(out[b], base[b], err_msg=f"row {b}")
+            continue
+        first = hits[0]
+        np.testing.assert_array_equal(out[b, : first + 1], base[b, : first + 1])
+        assert (out[b, first + 1 :] == 0).all(), f"row {b} not padded"
+    # at least one row must actually differ from another in when it ends,
+    # or this test isn't exercising mid-batch divergence
+    firsts = [
+        np.where(base[b] == eos)[0][0] if (base[b] == eos).any() else 99
+        for b in range(3)
+    ]
+    assert len(set(firsts)) > 1, f"degenerate fixture: {firsts}"
+
+
+def test_chunked_decode_matches_monolithic_bitwise():
+    """generate_chunked must reproduce generate() token-for-token at the
+    same rng for every chunking — including chunk=1 and a ragged tail —
+    with sampling filters AND eos padding active (the serving layer's
+    correctness floor)."""
+    from orion_tpu.generate import generate_chunked
+
+    model, params = _model_and_params()
+    prompt = jnp.ones((2, 5), jnp.int32)
+    cfg = SampleConfig(0.8, top_k=5, top_p=0.9, eos_token=3, pad_token=0)
+    rng = jax.random.PRNGKey(9)
+    ref = np.asarray(generate(model, params, prompt, 8, cfg, rng=rng))
+    for chunk in (1, 3, 8, 16):
+        out = generate_chunked(
+            model, params, prompt, 8, chunk=chunk, sample=cfg, rng=rng
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref, err_msg=f"chunk={chunk}")
 
 
 def test_profiling_step_timer():
