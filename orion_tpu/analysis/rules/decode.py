@@ -6,10 +6,22 @@ per-chunk decode loop stalls the device pipeline once per chunk: the next
 chunk's dispatch waits on the readback, turning the chunked serving walk
 into lockstep host-device ping-pong — the latency bug the chunked design
 exists to avoid. The serving layer has exactly ONE sanctioned sync per
-chunk — the scalar all-finite probe — and it lives in a designated probe
-function (``DecodeSession._probe_finite``), so the rule exempts any code
-lexically inside a function whose name contains ``probe``. Everything
-else syncs once, after the loop.
+chunk — the all-finite probe (scalar for the solo DecodeSession, one
+[slots]-bool vector for the slot-multiplexed SlotEngine) — and it lives
+in a designated probe function (``DecodeSession._probe_finite``,
+``SlotEngine._probe_bad``), so the rule exempts any code lexically inside
+a function whose name contains ``probe``. Everything else syncs once,
+after the loop.
+
+The probe exemption is itself budgeted for the continuous-batching
+scheduler loop: the per-chunk host sync must stay at ONE probe no matter
+how many slots are resident. Two extra shapes are findings —
+
+- two or more probe-function CALLS inside one decode loop body (each is
+  a separate device round-trip per chunk), and
+- a probe call inside a loop that is itself nested in another loop (the
+  per-slot-probe shape: ``for slot in slots: self._probe(slot)`` inside
+  the chunk loop syncs slot-count times per chunk).
 
 Scope: the decode modules only (``orion_tpu/serving/`` and
 ``generate.py``); host loops elsewhere (eval CLIs, data prep) may sync
@@ -20,7 +32,7 @@ about HOST loops driving the device.
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import Iterator, Optional
 
 from orion_tpu.analysis.findings import Finding
 from orion_tpu.analysis.lint import ModuleContext, dotted_name
@@ -48,6 +60,25 @@ def _inside_probe(node: ast.AST) -> bool:
     return False
 
 
+def _is_probe_call(node: ast.Call) -> bool:
+    """A call to a probe-named function/method (the designated sync)."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return "probe" in f.attr
+    if isinstance(f, ast.Name):
+        return "probe" in f.id
+    return False
+
+
+def _innermost_loop(node: ast.AST) -> Optional[ast.AST]:
+    cur = getattr(node, "_orion_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.While)):
+            return cur
+        cur = getattr(cur, "_orion_parent", None)
+    return None
+
+
 class DecodeHostSyncRule:
     id = "decode-host-sync"
     title = "host sync inside a per-chunk decode loop"
@@ -56,12 +87,19 @@ class DecodeHostSyncRule:
         if ctx.is_test or not _is_decode_module(ctx.path):
             return
         seen = set()
+        # loop -> probe calls whose INNERMOST loop it is (a nested loop's
+        # probes belong to the inner loop, so a chunk loop isn't blamed
+        # for its ladder helper's probes twice)
+        probes_per_loop: dict = {}
         for loop in ast.walk(ctx.tree):
             if not isinstance(loop, (ast.For, ast.While)):
                 continue
             for node in ast.walk(loop):
                 if not isinstance(node, ast.Call) or id(node) in seen:
                     continue
+                if _is_probe_call(node) and _innermost_loop(node) is loop:
+                    if not _inside_probe(node):
+                        probes_per_loop.setdefault(id(loop), (loop, []))[1].append(node)
                 name = dotted_name(node.func)
                 sync = None
                 if name in _SYNC_NAMES:
@@ -82,6 +120,25 @@ class DecodeHostSyncRule:
                     "trip every chunk; sync once after the loop, or move "
                     "it into the designated probe (a function named "
                     "*probe*, e.g. DecodeSession._probe_finite)",
+                )
+        # the probe budget: ONE probe sync per chunk loop, slot count
+        # notwithstanding (the continuous-batching scheduler contract)
+        for loop, calls in probes_per_loop.values():
+            if len(calls) > 1:
+                yield Finding(
+                    self.id, ctx.path, calls[1].lineno,
+                    f"{len(calls)} probe calls in one decode loop body — "
+                    "each is a separate device round-trip per chunk; fuse "
+                    "them into ONE probe (stack the flags device-side, "
+                    "one transfer, e.g. SlotEngine._probe_bad)",
+                )
+            elif _innermost_loop(loop) is not None:
+                yield Finding(
+                    self.id, ctx.path, calls[0].lineno,
+                    "probe call in a loop nested inside a decode loop — "
+                    "this syncs once PER ITERATION (per slot) per chunk; "
+                    "probe the whole batch with one vectorized transfer "
+                    "outside the inner loop",
                 )
 
 

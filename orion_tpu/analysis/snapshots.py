@@ -160,11 +160,52 @@ def _snap_decode_tiny() -> Tuple[Any, Any, Dict[str, Any]]:
     return jaxpr, lowered, meta
 
 
+def _snap_decode_batched_tiny() -> Tuple[Any, Any, Dict[str, Any]]:
+    """The slot-multiplexed batched decode chunk (continuous batching,
+    serving/batching.py SlotEngine) at slots=8, chunk=8 — the artifact
+    that pins the engine's compiled shape: scan-carry bytes must scale
+    LINEARLY in the slot count (each slot is one row of the O(1) state —
+    no paged-KV overhead) and the collective count stays zero (decode
+    never communicates). tests/test_batching.py asserts the linearity
+    against a slots=1 jaxpr rebuild."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import SampleConfig, _decode_batched_chunk_jit
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM, init_decode_state
+
+    cfg = get_config("tiny")
+    model = TransformerLM(cfg)
+    slots, chunk = 8, 8
+    key = jax.random.PRNGKey(0)
+    prompt = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    params = jax.eval_shape(model.init, key, prompt)
+    states = jax.eval_shape(partial(init_decode_state, cfg, slots))
+    vec = lambda dt: jax.ShapeDtypeStruct((slots,), dt)  # noqa: E731
+    carry = (
+        vec(jnp.int32), states, vec(jnp.int32), vec(jnp.int32),
+        vec(jnp.bool_),
+    )
+    rngs = jax.ShapeDtypeStruct((slots, 2), jnp.uint32)
+    active = vec(jnp.bool_)
+    args = (model, params, carry, rngs, active, chunk, SampleConfig())
+    jaxpr = jax.make_jaxpr(
+        _decode_batched_chunk_jit, static_argnums=(0, 5, 6)
+    )(*args)
+    lowered = _decode_batched_chunk_jit.lower(*args)
+    meta = {"slots": slots, "chunk": chunk, "donated_args": 0}
+    return jaxpr, lowered, meta
+
+
 # name -> () -> (closed_jaxpr, lowered, meta). Golden files live at
 # golden/<name>.json; adding a target here + --update-golden creates one.
 SNAPSHOT_TARGETS: Dict[str, Callable[[], Tuple[Any, Any, Dict[str, Any]]]] = {
     "train_tiny_dp8": _snap_train_tiny_dp8,
     "decode_tiny": _snap_decode_tiny,
+    "decode_batched_tiny": _snap_decode_batched_tiny,
 }
 
 

@@ -22,7 +22,7 @@ import argparse
 import dataclasses
 import sys
 from functools import partial
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -145,6 +145,73 @@ def _prefill_carry_jit(
     return (nxt, states, jnp.int32(tokens.shape[1]), done)
 
 
+@partial(jax.jit, static_argnums=(0, 3))
+def _prefill_carry_bucketed_jit(
+    model: TransformerLM,
+    params: Any,
+    tokens: Array,
+    sample_cfg: SampleConfig,
+    rng: Array,
+    sample_index: Array,
+    done: Array,
+    length: Array,
+) -> Tuple[Array, Any, Array, Array]:
+    """Bucketed prefill: ``tokens`` is right-padded to a bucket length and
+    ``length`` (traced) is the real prompt length — ONE compile per bucket
+    instead of one per novel prompt length (the compile-cache leak real
+    traffic would otherwise hit). The decode state and the first sampled
+    token are bitwise-identical to the unpadded compile's (masking
+    contract: transformer.Attention.prefill)."""
+    logits, states = model.apply(params, tokens, length, method="prefill_last")
+    nxt = sample_logits(
+        logits, jax.random.fold_in(rng, sample_index), sample_cfg
+    )
+    return (nxt, states, length, done)
+
+
+def bucket_for(length: int, buckets: Tuple[int, ...]) -> Optional[int]:
+    """Smallest bucket >= length, or None (prefill at the exact length)."""
+    for b in buckets:
+        if b >= length:
+            return b
+    return None
+
+
+def reprefill_carry(
+    model: TransformerLM,
+    params: Any,
+    prompt: Array,
+    emitted: List[Array],
+    sample_cfg: SampleConfig,
+    rng: Array,
+    buckets: Tuple[int, ...] = (),
+):
+    """Rebuild a decode carry from prompt + the tokens already emitted —
+    the degradation ladder's re-prefill rung, shared by the solo
+    DecodeSession and the SlotEngine so the rung's semantics cannot
+    diverge: ``sample_index = n`` keeps the rng fold_in sequence aligned
+    with the uninterrupted walk, and ``done`` is recomputed from the
+    emitted tokens (rows that already hit EOS stay done).
+
+    Caveat (both callers): rows that emitted EOS are rebuilt from their
+    PAD-filled tail rather than the post-EOS samples the uninterrupted
+    carry held — those rows keep emitting PAD either way, but their
+    dead-state contents differ from an uninterrupted run's."""
+    seq = (
+        jnp.concatenate([prompt] + list(emitted), axis=1)
+        if emitted
+        else prompt
+    )
+    n = seq.shape[1] - prompt.shape[1]
+    done = None
+    if sample_cfg.eos_token >= 0:
+        done = (seq[:, prompt.shape[1]:] == sample_cfg.eos_token).any(axis=1)
+    return prefill_carry(
+        model, params, seq, sample_cfg, rng,
+        sample_index=n, done=done, buckets=buckets,
+    )
+
+
 def prefill_carry(
     model: TransformerLM,
     params: Any,
@@ -153,16 +220,32 @@ def prefill_carry(
     rng: Array,
     sample_index: int = 0,
     done: Optional[Array] = None,
+    buckets: Tuple[int, ...] = (),
 ):
     """tokens [B, T] -> the decode carry (next_token, states, t, done).
 
     ``sample_index`` is the rng fold_in key for the first sampled token —
     0 for a fresh prompt (matching ``generate()``), or ``n`` when
     re-prefilling after ``n`` tokens were already emitted (the serving
-    degradation ladder's second rung)."""
+    degradation ladder's second rung).
+
+    ``buckets``: sorted pad-to lengths for bucketed prefill (empty = off).
+    The prompt is right-padded to the smallest bucket >= T and the real
+    length rides in traced, so the jit cache stays bounded by the bucket
+    count; a prompt longer than every bucket falls back to exact-length."""
     tokens = jnp.asarray(tokens, jnp.int32)
     if done is None:
         done = jnp.zeros((tokens.shape[0],), bool)
+    t = tokens.shape[1]
+    pad_to = bucket_for(t, buckets) if buckets else None
+    if pad_to is not None:
+        # a bucket-exact prompt still goes through the bucketed compile
+        # (length == pad_to): ONE cache entry per bucket, period
+        padded = jnp.pad(tokens, ((0, 0), (0, pad_to - t)))
+        return _prefill_carry_bucketed_jit(
+            model, params, padded, sample_cfg, rng,
+            jnp.int32(sample_index), done, jnp.int32(t),
+        )
     return _prefill_carry_jit(
         model, params, tokens, sample_cfg, rng, jnp.int32(sample_index), done
     )
@@ -201,6 +284,87 @@ def decode_chunk(
     return _decode_chunk_jit(
         model, params, carry, rng, int(n_steps), sample_cfg,
         jnp.int32(start),
+    )
+
+
+# -- slot-multiplexed batched decode (continuous batching) --------------------
+# The SlotEngine (orion_tpu/serving/batching.py) multiplexes independent
+# requests over the rows of ONE batched carry: per-slot positions (vector
+# t), per-slot rng streams folded from each request's own seed, and a
+# per-slot active mask. The body below is _decode_body generalized row-wise
+# — every op is batch-row-independent, so each slot's walk is
+# bitwise-identical to serving that request alone (the acceptance property
+# tests/test_batching.py pins for slot counts {2, 4, 8}).
+
+
+def _sample_rows(logits: Array, keys: Array, cfg: SampleConfig) -> Array:
+    """Per-row sampling with per-row keys: row b is bitwise what
+    ``sample_logits(logits[b:b+1], keys[b], cfg)`` returns solo (threefry
+    is counter-based, so the vmapped draw equals the unbatched one)."""
+    if cfg.greedy:
+        return jnp.argmax(logits, axis=-1)
+    return jax.vmap(lambda lg, k: sample_logits(lg[None], k, cfg)[0])(
+        logits, keys
+    )
+
+
+def _decode_batched_body(
+    model, params, sample_cfg: SampleConfig, rngs, active, carry, _
+):
+    """One slot-multiplexed decode step. carry = (token [S], states,
+    t [S], emit [S], done [S]); ``rngs`` [S, 2] are per-slot PRNG keys
+    (each request's own seed — REQUIRED for batched-vs-solo bitwise
+    parity), ``emit`` the per-slot absolute emitted-token index (each
+    slot's rng fold_in key, the vector form of _decode_body's ``i``),
+    ``active`` [S] masks free slots (their rows still compute — the scan
+    shape is static — but emit PAD and hold their position)."""
+    token, states, t, emit, done = carry
+    logits, states = model.apply(params, token, states, t, method="decode_step")
+    keys = jax.vmap(jax.random.fold_in)(rngs, emit + 1)
+    nxt = _sample_rows(logits, keys, sample_cfg)
+    if sample_cfg.eos_token >= 0:
+        emitted = jnp.where(done, sample_cfg.pad_token, token)
+        done = done | (emitted == sample_cfg.eos_token)
+    else:
+        emitted = token
+    emitted = jnp.where(active, emitted, sample_cfg.pad_token)
+    t = jnp.where(active, t + 1, t)  # free slots must not walk off the
+    emit = emit + 1                  # positional/rotary tables
+    return (nxt, states, t, emit, done), emitted
+
+
+@partial(jax.jit, static_argnums=(0, 5, 6))
+def _decode_batched_chunk_jit(
+    model: TransformerLM,
+    params: Any,
+    carry: Any,
+    rngs: Array,
+    active: Array,
+    n_steps: int,
+    sample_cfg: SampleConfig,
+) -> Tuple[Any, Array]:
+    body = partial(_decode_batched_body, model, params, sample_cfg, rngs, active)
+    carry, tokens = jax.lax.scan(body, carry, None, length=n_steps)
+    return carry, jnp.moveaxis(tokens, 0, 1)  # [S, n_steps]
+
+
+def decode_batched_chunk(
+    model: TransformerLM,
+    params: Any,
+    carry: Any,
+    rngs: Array,
+    active: Array,
+    n_steps: int,
+    sample_cfg: SampleConfig,
+):
+    """Advance the slot-multiplexed carry by ``n_steps`` tokens (one
+    bounded scan over ALL slots). Everything per-slot — positions, emit
+    indices, rng keys, the active mask — rides in traced, so the engine's
+    whole serving lifetime costs ONE compile per (slot count, chunk
+    length) regardless of arrival order (asserted via jit cache stats in
+    tests/test_batching.py)."""
+    return _decode_batched_chunk_jit(
+        model, params, carry, rngs, active, int(n_steps), sample_cfg
     )
 
 

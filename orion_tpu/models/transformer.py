@@ -315,12 +315,35 @@ class Attention(nn.Module):
 
     # -- prefill: forward + decode state ------------------------------------
 
-    def prefill(self, x: Array) -> Tuple[Array, State]:
+    def prefill(self, x: Array, length: Optional[Array] = None) -> Tuple[Array, State]:
+        """``length``: optional traced per-call REAL prompt length when
+        ``x`` is right-padded to a bucket (serving's prompt-length
+        bucketing, one compile per bucket instead of per novel length).
+        The decode state must come out bitwise-equal to an unpadded
+        prefill of ``x[:, :length]``:
+
+        - linear — pad positions' phi(k)/v rows are zeroed BEFORE the
+          kv-cumsum, so S/z accumulate only real contributions (adding
+          exact zeros is bitwise-exact) and every real position's output
+          is untouched (causal: it never sees later rows).
+        - softmax — the padded KV rows land at cache slots >= length,
+          which decode never reads: step t overwrites slot t before
+          attending and masks slots > t (see decode_step), so no masking
+          is needed here.
+        - swa — the ring cache is built from the last ``window`` REAL
+          positions via a traced gather/scatter
+          (:func:`_swa_cache_from_prefill_dynamic`)."""
         cfg = self.cfg
         q, k, v = self._heads(x)
         t = x.shape[-2]
         if self.layer_type == "linear":
             qf, kf = self._phi_map(q), self._phi_map(k)
+            if length is not None:
+                # where (not multiply): 0*nan from a degenerate feature
+                # map must not poison the masked state
+                real = (jnp.arange(t) < length)[None, None, :, None]
+                kf = jnp.where(real, kf, jnp.zeros_like(kf))
+                v = jnp.where(real, v, jnp.zeros_like(v))
             out, (s, z) = self._kernel_bh(
                 lambda a, b, c: linear_attention(
                     a, b, c, backend=cfg.backend, chunk=cfg.chunk,
@@ -342,7 +365,12 @@ class Attention(nn.Module):
                     ),
                     qr, kr, v,
                 )
-                state = _swa_cache_from_prefill(kr, v, t, cfg.window)
+                if length is not None:
+                    state = _swa_cache_from_prefill_dynamic(
+                        kr, v, length, cfg.window
+                    )
+                else:
+                    state = _swa_cache_from_prefill(kr, v, t, cfg.window)
             else:
                 out = self._kernel_bh(
                     lambda a, b, c: softmax_attention(
@@ -359,29 +387,48 @@ class Attention(nn.Module):
     # -- one-token decode ---------------------------------------------------
 
     def decode_step(self, x: Array, state: State, t: Array) -> Tuple[Array, State]:
-        """x: [B, D] one token; t: scalar int32 absolute position."""
+        """x: [B, D] one token; t: int32 absolute position — a scalar
+        (whole batch at one position: generate()'s lockstep scan) or a
+        per-sequence [B] vector (slot-multiplexed serving: each batch row
+        is an independent request at its own position)."""
         cfg = self.cfg
+        t = jnp.asarray(t)
+        per_seq = t.ndim == 1
         q, k, v = self._heads(x)  # [B, H, Dh]
         if self.layer_type == "linear":
             qf, kf = self._phi_map(q), self._phi_map(k)
             out, (s, z) = recurrent_step(qf, kf, v, (state["s"], state["z"]))
             new_state = {"s": s, "z": z}
         else:
-            qr = apply_rotary_at(q, self.freqs, t)
-            kr = apply_rotary_at(k, self.freqs, t)
+            # per-seq positions: angles gather [B, 1, Dh/2] broadcasts over
+            # heads the way the scalar gather's [Dh/2] row does
+            pos = t[:, None] if per_seq else t
+            qr = apply_rotary_at(q, self.freqs, pos)
+            kr = apply_rotary_at(k, self.freqs, pos)
             cap = state["k"].shape[-2]  # window W or max_seq_len
             slot = t % cap if self.layer_type == "swa" else t
-            kc = jax.lax.dynamic_update_slice_in_dim(
-                state["k"], kr[:, :, None, :].astype(state["k"].dtype), slot, axis=2
-            )
-            vc = jax.lax.dynamic_update_slice_in_dim(
-                state["v"], v[:, :, None, :].astype(state["v"].dtype), slot, axis=2
-            )
-            # ring slots hold positions (t-W, t] once warm; before that,
-            # slots (t, W) are still unwritten — in both cases exactly the
-            # slots with index <= t are valid (softmax is permutation-
-            # invariant over keys, so rotation needs no unrotation).
-            valid = (jnp.arange(cap) <= t)[None, None, :]
+            if per_seq:
+                # one scatter row per sequence at its own slot
+                b_idx = jnp.arange(x.shape[0])
+                kc = state["k"].at[b_idx, :, slot, :].set(
+                    kr.astype(state["k"].dtype)
+                )
+                vc = state["v"].at[b_idx, :, slot, :].set(
+                    v.astype(state["v"].dtype)
+                )
+                valid = jnp.arange(cap)[None, None, :] <= t[:, None, None]
+            else:
+                kc = jax.lax.dynamic_update_slice_in_dim(
+                    state["k"], kr[:, :, None, :].astype(state["k"].dtype), slot, axis=2
+                )
+                vc = jax.lax.dynamic_update_slice_in_dim(
+                    state["v"], v[:, :, None, :].astype(state["v"].dtype), slot, axis=2
+                )
+                # ring slots hold positions (t-W, t] once warm; before that,
+                # slots (t, W) are still unwritten — in both cases exactly the
+                # slots with index <= t are valid (softmax is permutation-
+                # invariant over keys, so rotation needs no unrotation).
+                valid = (jnp.arange(cap) <= t)[None, None, :]
             out = cached_attention(qr, kc, vc, valid)
             new_state = {"k": kc, "v": vc}
         return self._merge(out, single=True), new_state
@@ -409,6 +456,30 @@ def _swa_cache_from_prefill(kr: Array, v: Array, t: int, window: int) -> State:
         v[:, :, start:t, :]
     )
     del n
+    return {"k": kc, "v": vc}
+
+
+def _swa_cache_from_prefill_dynamic(
+    kr: Array, v: Array, length: Array, window: int
+) -> State:
+    """:func:`_swa_cache_from_prefill` with a TRACED real length (bucketed
+    prefill pads the prompt, so the ring must be built from the last
+    ``window`` positions BEFORE ``length``, not before the padded end).
+    Positions < 0 (prompt shorter than the window) write a clipped-gather
+    row into their slot; those slots are never read — decode's
+    ``slot <= t`` rule excludes a slot until the step that overwrites it
+    (see decode_step) — so the garbage is harmless and the readable
+    entries are bitwise-identical to the static builder's."""
+    b, h, t_pad, dh = kr.shape
+    positions = length - window + jnp.arange(window)  # [W], may be < 0
+    slots = positions % window
+    safe = jnp.clip(positions, 0, t_pad - 1)
+    kc = jnp.zeros((b, h, window, dh), kr.dtype).at[:, :, slots, :].set(
+        jnp.take(kr, safe, axis=2)
+    )
+    vc = jnp.zeros((b, h, window, v.shape[-1]), v.dtype).at[:, :, slots, :].set(
+        jnp.take(v, safe, axis=2)
+    )
     return {"k": kc, "v": vc}
 
 
@@ -477,8 +548,8 @@ class Block(nn.Module):
         x = x + self.drop(self.mlp(self.norm2(x)), deterministic=deterministic)
         return x
 
-    def prefill(self, x):
-        h, state = self.attn.prefill(self.norm1(x))
+    def prefill(self, x, length=None):
+        h, state = self.attn.prefill(self.norm1(x), length)
         x = x + h
         x = x + self.mlp(self.norm2(x))
         return x, state
@@ -641,29 +712,40 @@ class TransformerLM(nn.Module):
             return p["embed"]["embedding"], True
         return p["lm_head_kernel"], False
 
-    def _prefill_trunk(self, tokens: Array) -> Tuple[Array, List[State]]:
-        """Shared embed + per-block state-collecting forward -> (x, states)."""
+    def _prefill_trunk(
+        self, tokens: Array, length: Optional[Array] = None
+    ) -> Tuple[Array, List[State]]:
+        """Shared embed + per-block state-collecting forward -> (x, states).
+        ``length``: traced real prompt length when ``tokens`` is padded to
+        a bucket (see Attention.prefill)."""
         t = tokens.shape[-1]
         x = self._embed(tokens, jnp.arange(t))
         states = []
         for blk in self.blocks:
-            x, st = blk.prefill(x)
+            x, st = blk.prefill(x, length)
             states.append(st)
         return x, states
 
-    def prefill(self, tokens: Array) -> Tuple[Array, List[State]]:
+    def prefill(self, tokens: Array, length: Optional[Array] = None) -> Tuple[Array, List[State]]:
         """tokens [B, T] -> (logits [B, T, V], per-layer decode states)."""
-        x, states = self._prefill_trunk(tokens)
+        x, states = self._prefill_trunk(tokens, length)
         return self._head(x), states
 
-    def prefill_last(self, tokens: Array) -> Tuple[Array, List[State]]:
+    def prefill_last(
+        self, tokens: Array, length: Optional[Array] = None
+    ) -> Tuple[Array, List[State]]:
         """prefill, but the head matmul runs on the LAST position only ->
         (logits [B, V], states). Generation needs nothing else, and the
         full-prompt head is the difference between a [B, T, V] fp32 tensor
         (4.3GB at T=32k) and a [B, V] row — long-prompt serving fits
         because of this (generate.py uses it; ``prefill`` keeps the full
-        contract for parity tests and scoring)."""
-        x, states = self._prefill_trunk(tokens)
+        contract for parity tests and scoring). With ``length`` (bucketed
+        prefill), the head runs on the last REAL position ``length - 1``,
+        not the padded end."""
+        x, states = self._prefill_trunk(tokens, length)
+        if length is not None:
+            last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
+            return self._head(last)[:, 0], states
         return self._head(x[:, -1:, :])[:, 0], states
 
     def decode_step(
@@ -706,6 +788,53 @@ def decode_state_finite(states: List[State]) -> Array:
     return _all_finite(states)
 
 
+@jax.jit
+def _per_slot_finite(states: List[State]) -> Array:
+    b = jax.tree.leaves(states)[0].shape[0]
+    acc = jnp.ones((b,), bool)
+    for leaf in jax.tree.leaves(states):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            acc = jnp.logical_and(
+                acc,
+                jnp.all(jnp.isfinite(leaf.reshape(leaf.shape[0], -1)), axis=1),
+            )
+    return acc
+
+
+def decode_state_finite_per_slot(states: List[State]) -> Array:
+    """Per-SEQUENCE all-finite probe: [B] bool vector, one entry per slot
+    of the batched decode state. The slot-multiplexed serving engine
+    (orion_tpu/serving/batching.py) replaces the global scalar probe with
+    this so one poisoned slot walks the degradation ladder for THAT
+    request only while co-resident slots keep streaming. Still ONE device
+    reduction and one host transfer per chunk regardless of slot count."""
+    return _per_slot_finite(states)
+
+
+def insert_decode_slot(
+    states: List[State], slot_states: List[State], i: Array
+) -> List[State]:
+    """Write a single sequence's decode state (batch dim 1 — the output
+    of a solo prefill) into row ``i`` of the batched per-layer state
+    pytree. Row writes are ``.at[i].set`` scatters, so under jit the
+    whole admission costs one fused update per leaf; everything about the
+    slot's previous occupant is overwritten."""
+    return jax.tree.map(
+        lambda full, one: full.at[i].set(one[0]), states, slot_states
+    )
+
+
+def extract_decode_slot(states: List[State], i: Array) -> List[State]:
+    """Row ``i`` of the batched decode state as a batch-of-1 state pytree —
+    the inverse of :func:`insert_decode_slot`, for diagnostics and the
+    round-trip tests (the engine itself never extracts: its re-prefill
+    rung rebuilds state from the emitted tokens instead, since a poisoned
+    row is exactly what it must NOT reuse)."""
+    return jax.tree.map(
+        lambda x: jax.lax.dynamic_slice_in_dim(x, i, 1, axis=0), states
+    )
+
+
 def init_decode_state(
     cfg: ModelConfig, batch_size: int, dtype: Any = None
 ) -> List[State]:
@@ -738,4 +867,6 @@ def init_decode_state(
 __all__ = [
     "TransformerLM", "Attention", "Block", "MLP", "init_decode_state",
     "snapshot_decode_state", "decode_state_finite",
+    "decode_state_finite_per_slot", "insert_decode_slot",
+    "extract_decode_slot",
 ]

@@ -34,6 +34,11 @@ Hook sites wired today:
                           decode state to NaN — each rung of the serving
                           degradation ladder is reached by arming 1, 2, or
                           unlimited deliveries at the same chunk
+``"decode.slot_nan.K"``   consumed via :func:`decode_slot_nan_armed` by the
+                          slot-multiplexed SlotEngine (serving/batching.py)
+                          to poison ONLY slot K's rows of the batched decode
+                          state at that request's chunk index — the per-slot
+                          ladder's chaos address
 ========================  ====================================================
 
 Also here: :func:`corrupt_step` / :func:`truncate_step`, which damage a
@@ -54,6 +59,12 @@ from typing import Callable, List, Optional
 _NAN_SITE = "train.nan"
 _DECODE_NAN_SITE = "decode.state_nan"
 _CHUNK_SITE = "serve.chunk"
+
+
+def _decode_slot_site(slot: int) -> str:
+    """Slot-addressed decode-state poisoning site (the batched engine's
+    per-slot analogue of ``decode.state_nan``)."""
+    return f"decode.slot_nan.{slot}"
 
 
 @dataclasses.dataclass
@@ -133,6 +144,17 @@ class FaultPlan:
         fails the request — never the process."""
         return self.add(_DECODE_NAN_SITE, chunk, times, None)
 
+    def poison_decode_slot_at(
+        self, slot: int, chunk: int, times: int = 1
+    ) -> "FaultPlan":
+        """Arm NaN-poisoning of ONE slot's rows of the slot-multiplexed
+        batched decode state (serving/batching.py SlotEngine), at that
+        slot's request-local chunk index. The per-slot ladder semantics
+        mirror :meth:`poison_decode_state_at` — but only request ``slot``
+        walks the ladder; co-resident slots must keep streaming
+        untouched (the chaos acceptance in tests/test_batching.py)."""
+        return self.add(_decode_slot_site(slot), chunk, times, None)
+
     # -- delivery ------------------------------------------------------------
 
     def _take(self, site: str, step: Optional[int]) -> Optional[_Fault]:
@@ -204,6 +226,16 @@ def decode_nan_armed(chunk: int) -> bool:
     return plan is not None and plan.consume_marker(_DECODE_NAN_SITE, chunk)
 
 
+def decode_slot_nan_armed(slot: int, chunk: int) -> bool:
+    """Is a slot-addressed decode-state poisoning armed for (slot, that
+    request's chunk index)? Consumed per attempt, like
+    :func:`decode_nan_armed` (the SlotEngine also consumes the legacy
+    unaddressed site so single-request plans behave as under the solo
+    DecodeSession)."""
+    plan = _active
+    return plan is not None and plan.consume_marker(_decode_slot_site(slot), chunk)
+
+
 # -- on-disk checkpoint corruption (test control, not a hook) -----------------
 
 
@@ -247,5 +279,6 @@ def truncate_step(ckpt_dir: str, step: int) -> List[str]:
 
 __all__ = [
     "FaultPlan", "inject", "active", "fire", "nan_armed",
-    "decode_nan_armed", "corrupt_step", "truncate_step",
+    "decode_nan_armed", "decode_slot_nan_armed", "corrupt_step",
+    "truncate_step",
 ]

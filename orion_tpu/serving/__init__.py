@@ -1,20 +1,29 @@
-"""Serving resilience: the inference-side counterpart of the training
-resilience stack (orion_tpu/resilience/, PR 2).
+"""Serving: continuous batching + the inference-side counterpart of the
+training resilience stack (orion_tpu/resilience/, PR 2).
 
-- :mod:`session` — :class:`DecodeSession`: chunked decode with per-chunk
-  state snapshots, a jitted all-finite probe, a rewind -> re-prefill ->
-  fail-request degradation ladder, and chunk-granular deadlines.
-- :mod:`server`  — :class:`Server`: bounded admission with explicit
-  shed-on-overload, per-request isolation, watchdog heartbeats, and
-  SIGTERM -> drain (finish in-flight, reject new, exit 0).
+- :mod:`batching` — :class:`SlotEngine`: slot-multiplexed continuous
+  batching — a fixed number of requests share one jitted batched decode
+  scan (O(1) recurrent state makes a "slot" just a row of the carry);
+  admission/eviction at chunk boundaries, per-slot degradation ladder.
+- :mod:`session` — :class:`DecodeSession`: single-request chunked decode
+  with per-chunk state snapshots, a jitted all-finite probe, a rewind ->
+  re-prefill -> fail-request degradation ladder, and chunk-granular
+  deadlines (the slots=1-equivalent reference path; the engine's parity
+  oracle).
+- :mod:`server`  — :class:`Server`: the scheduler loop over the engine —
+  bounded admission with explicit shed-on-overload, per-request
+  isolation, watchdog heartbeats, and SIGTERM -> drain (finish in-flight
+  slots, reject new, exit 0).
 - :mod:`health`  — the validated STARTING -> SERVING <-> DEGRADED ->
   DRAINING -> DEAD process health state machine.
 
-``python -m orion_tpu.serving`` is the CLI (``--deadline-ms``,
-``--max-inflight``, ``--chunk``; see README "Resilient serving"). The
-chaos coverage lives in tests/test_serving.py under the ``chaos`` marker.
+``python -m orion_tpu.serving`` is the CLI (``--slots``, ``--chunk``,
+``--deadline-ms``, ``--max-inflight``, ``--prefill-buckets``; see README
+"Resilient serving"). The chaos coverage lives in tests/test_serving.py
+and tests/test_batching.py under the ``chaos`` marker.
 """
 
+from orion_tpu.serving.batching import SlotEngine, parse_buckets
 from orion_tpu.serving.health import Health, HealthMachine, InvalidTransition
 from orion_tpu.serving.server import (
     OverloadError,
@@ -34,6 +43,6 @@ from orion_tpu.serving.session import (
 __all__ = [
     "Health", "HealthMachine", "InvalidTransition",
     "Server", "ServeConfig", "Pending", "OverloadError", "RejectedError",
-    "load_tokenizer",
+    "load_tokenizer", "SlotEngine", "parse_buckets",
     "DecodeRequest", "DecodeResult", "DecodeSession", "LadderExhausted",
 ]

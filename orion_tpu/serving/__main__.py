@@ -47,7 +47,15 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--chunk", type=int, default=16,
                    help="decode chunk length: the deadline / snapshot / "
-                        "drain granularity")
+                        "drain / admission granularity")
+    p.add_argument("--slots", type=int, default=8,
+                   help="concurrent decode slots sharing one batched scan "
+                        "(continuous batching); 1 = the serial PR 4 "
+                        "behaviour")
+    p.add_argument("--prefill-buckets", default="pow2",
+                   help="prompt-length buckets for prefill padding: 'pow2' "
+                        "(default), a comma list like '32,64,128', or 'off' "
+                        "(one prefill compile per novel prompt length)")
     p.add_argument("--deadline-ms", type=float, default=0.0,
                    help="per-request deadline, enforced at chunk "
                         "boundaries (0 = none)")
@@ -136,9 +144,10 @@ def _run(args, guard) -> int:
     server = Server(
         model, params,
         ServeConfig(
-            chunk=args.chunk, max_inflight=args.max_inflight,
+            chunk=args.chunk, slots=args.slots,
+            max_inflight=args.max_inflight,
             deadline_ms=args.deadline_ms, stall_timeout=args.stall_timeout,
-            grace=args.grace,
+            grace=args.grace, prefill_buckets=args.prefill_buckets,
         ),
     )
     completed = []  # (prompt, Pending) in submission order
@@ -191,6 +200,8 @@ def _run(args, guard) -> int:
         tag = "" if r.status == "ok" else f" [{r.status}]"
         print(line + tok.decode(ids) + tag)
     print(f"stats: {server.stats}", file=sys.stderr)
+    print(f"slot occupancy: {server.occupancy():.3f} "
+          f"({args.slots} slot(s), chunk {args.chunk})", file=sys.stderr)
     return rc
 
 

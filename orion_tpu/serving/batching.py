@@ -1,0 +1,448 @@
+"""SlotEngine: slot-multiplexed continuous batching for the decode path.
+
+PR 4's :class:`~orion_tpu.serving.session.DecodeSession` serves one request
+at a time — correct, resilient, and leaving (N-1)/N of the hardware's batch
+throughput on the table. The paper's recurrent formulation makes the fix
+cheap: every sequence's decode state is O(1) — a few (S, z) matrices and
+fixed-size caches per layer — so a "slot" is nothing but one ROW of a
+batched state pytree. No paged KV, no block tables, no attention-kernel
+surgery: Orca-style iteration-level scheduling reduces to row inserts and
+row evictions on one carry.
+
+- **slots** — a fixed number of rows share ONE jitted chunked decode scan
+  (``generate.decode_batched_chunk``). The slot count is static, so the
+  whole serving lifetime costs one decode compile per (slots, chunk)
+  regardless of arrival order; per-slot positions (vector ``t``), per-slot
+  rng streams, and the active mask all ride in traced.
+- **admission** — at chunk boundaries only: a new request is prefilled
+  individually (``generate.prefill_carry``, optionally bucket-padded), then
+  its state / first token / position are row-written into a free slot
+  (``transformer.insert_decode_slot``). Mid-stream admission at a nonzero
+  position is the normal case, not an edge case.
+- **eviction** — a slot is freed at the boundary where its request
+  finishes: per-slot EOS (every later token is PAD by construction, so the
+  tail is filled host-side, bitwise what the solo scan emits), max-tokens,
+  or its deadline. Freed rows keep computing inside the scan (static shape)
+  but emit PAD and hold their position.
+- **per-slot ladder** — the finite probe is per-SEQUENCE
+  (``transformer.decode_state_finite_per_slot``): one poisoned slot walks
+  PR 4's degradation ladder — rewind (redo the chunk from the boundary
+  snapshot; co-resident slots recompute bitwise-identical tokens) →
+  re-prefill that request from its prompt + emitted tokens → fail THAT
+  request — while the other slots keep streaming. Still one host sync per
+  chunk attempt, a [slots]-bool vector instead of PR 4's scalar.
+- **bitwise parity** — every device op in the batched body is batch-row
+  independent and each slot folds its own request's seed, so N multiplexed
+  requests produce tokens BITWISE-identical to N solo runs at the same
+  seeds (tests/test_batching.py pins this for slots {2, 4, 8}, greedy and
+  sampled, including late admission).
+
+The engine owns no threads and installs no handlers; the Server drives it
+from its scheduler loop and maps finished slots back onto Pendings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.generate import (
+    SampleConfig,
+    decode_batched_chunk,
+    prefill_carry,
+    reprefill_carry,
+)
+from orion_tpu.models.transformer import (
+    decode_state_finite_per_slot,
+    init_decode_state,
+    insert_decode_slot,
+    snapshot_decode_state,
+)
+from orion_tpu.resilience import inject
+from orion_tpu.serving.session import DecodeRequest, DecodeResult
+
+Array = jax.Array
+
+
+@jax.jit
+def _slot_flags(states, done) -> Array:
+    """[2, slots] bool: per-slot finite mask stacked with the done flags —
+    the engine's whole per-chunk host readback in ONE device transfer."""
+    return jnp.stack([decode_state_finite_per_slot(states), done])
+
+
+@jax.jit
+def _insert_carry(carry, rngs, sub_carry, rng, i, n_emitted):
+    """Row-write one solo prefill carry (batch 1) + its rng key into slot
+    ``i`` of the batched carry — ONE fused dispatch for the whole
+    admission (a dozen eager ``.at`` updates would cost more host time
+    than the prefill itself; admissions sit on the scheduler's hot path).
+    ``i`` and ``n_emitted`` ride traced: one compile, ever."""
+    token, states, t, emit, done = carry
+    tok1, st1, t1, done1 = sub_carry
+    new_carry = (
+        token.at[i].set(tok1[0]),
+        insert_decode_slot(states, st1, i),
+        t.at[i].set(t1.astype(jnp.int32)),
+        emit.at[i].set(n_emitted.astype(jnp.int32)),
+        done.at[i].set(done1[0]),
+    )
+    return new_carry, rngs.at[i].set(rng)
+
+
+def parse_buckets(spec: str, max_seq_len: int) -> Tuple[int, ...]:
+    """``--prefill-buckets`` spec -> sorted bucket lengths. ``"pow2"``:
+    powers of two from 16 up to max_seq_len; ``"a,b,c"``: explicit;
+    ``""``/``"off"``: disabled (one prefill compile per novel length)."""
+    if not spec or spec == "off":
+        return ()
+    if spec == "pow2":
+        out, b = [], 16
+        while b < max_seq_len:
+            out.append(b)
+            b *= 2
+        out.append(max_seq_len)
+        return tuple(out)
+    buckets = sorted({int(x) for x in spec.split(",") if x.strip()})
+    if any(b <= 0 or b > max_seq_len for b in buckets):
+        raise ValueError(
+            f"prefill buckets must be in (0, max_seq_len={max_seq_len}]: {buckets}"
+        )
+    return tuple(buckets)
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host-side bookkeeping for one resident request."""
+
+    request: DecodeRequest
+    tag: Any
+    deadline_at: Optional[float]
+    prompt: Array  # [1, T] int32 (kept for the re-prefill rung)
+    # per-chunk (tokens [S, chunk], my row) — the row is NOT sliced at the
+    # boundary (that would cost O(slots) device calls per chunk on the
+    # scheduler's hot path) but lazily at eviction/re-prefill
+    toks: List[Tuple[Array, int]]
+    n_emitted: int = 0
+    chunks: int = 0  # request-local chunk index (fault-hook address)
+    rewinds: int = 0
+    reprefills: int = 0
+
+
+class SlotEngine:
+    """Fixed-slot batched decode engine. One engine serves many requests
+    over its lifetime; all resident requests share one static
+    :class:`SampleConfig` (the jitted scan body's static argument — a
+    mismatched request must be refused at admission, the Server surfaces
+    it as that request's error)."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        slots: int = 8,
+        chunk: int = 16,
+        clock: Callable[[], float] = time.monotonic,
+        prefill_buckets: Tuple[int, ...] = (),
+    ):
+        assert slots > 0, slots
+        assert chunk > 0, chunk
+        self.model = model
+        self.params = params
+        self.slots = int(slots)
+        self.chunk = int(chunk)
+        self._clock = clock
+        self.buckets = tuple(prefill_buckets)
+        self._sample: Optional[SampleConfig] = None  # set by first admit
+        self._slots: List[Optional[_Slot]] = [None] * self.slots
+        self._chunk_counter = 0  # global boundary index (serve.chunk hook)
+        # device carry: (token [S], states, t [S], emit [S], done [S])
+        cfg = model.cfg
+        self._carry = (
+            jnp.zeros((self.slots,), jnp.int32),
+            init_decode_state(cfg, self.slots),
+            jnp.zeros((self.slots,), jnp.int32),
+            jnp.zeros((self.slots,), jnp.int32),
+            jnp.ones((self.slots,), bool),  # free slots are "done"
+        )
+        self._rngs = jnp.tile(
+            jax.random.PRNGKey(0)[None], (self.slots, 1)
+        )
+        self._done_np = np.ones((self.slots,), bool)
+
+    # -- occupancy ------------------------------------------------------------
+
+    @property
+    def active_count(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def busy(self) -> bool:
+        return self.active_count > 0
+
+    @property
+    def has_free_slot(self) -> bool:
+        return self.active_count < self.slots
+
+    def occupancy(self) -> Dict[str, int]:
+        """Slot gauges for health/stats reporting."""
+        return {
+            "slots": self.slots,
+            "active": self.active_count,
+            "free": self.slots - self.active_count,
+        }
+
+    # -- admission ------------------------------------------------------------
+
+    def admit(
+        self,
+        request: DecodeRequest,
+        tag: Any = None,
+        deadline_at: Optional[float] = None,
+    ) -> int:
+        """Prefill ``request`` solo and insert it into a free slot.
+        Raises ValueError for requests the engine cannot multiplex (no
+        free slot, batch != 1, over-capacity, or a SampleConfig differing
+        from the resident batch's static config); the caller decides
+        whether that fails the request or reroutes it."""
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            raise RuntimeError("no free slot")
+        prompt = jnp.asarray(request.prompt, jnp.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        if prompt.shape[0] != 1:
+            raise ValueError(
+                f"slot-multiplexed serving takes one sequence per request; "
+                f"got a batch of {prompt.shape[0]} (split it into requests)"
+            )
+        cap = self.model.cfg.max_seq_len
+        if prompt.shape[1] + request.max_new_tokens > cap:
+            raise ValueError(
+                f"prompt {prompt.shape[1]} + new {request.max_new_tokens} "
+                f"exceeds max_seq_len {cap}"
+            )
+        if self._sample is None or not self.busy:
+            self._sample = request.sample
+        elif request.sample != self._sample:
+            raise ValueError(
+                "request's SampleConfig differs from the resident batch's; "
+                "the slot scan's sampling parameters are static per batch"
+            )
+        i = free[0]
+        rng = jax.random.PRNGKey(request.seed)
+        sub = prefill_carry(
+            self.model, self.params, prompt, self._sample, rng,
+            buckets=self.buckets,
+        )
+        self._insert(i, sub, rng)
+        self._slots[i] = _Slot(
+            request=request,
+            tag=tag,
+            deadline_at=deadline_at,
+            prompt=prompt,
+            toks=[],
+        )
+        return i
+
+    def _insert(self, i: int, sub_carry, rng: Array, n_emitted: int = 0) -> None:
+        """Row-write a solo carry (batch 1) into slot ``i`` of the batched
+        carry (one fused jitted dispatch; see :func:`_insert_carry`)."""
+        self._carry, self._rngs = _insert_carry(
+            self._carry, self._rngs, sub_carry, rng,
+            jnp.int32(i), jnp.int32(n_emitted),
+        )
+
+    # -- the chunk step -------------------------------------------------------
+
+    def step(self) -> List[Tuple[Any, DecodeResult]]:
+        """Advance every resident slot by one chunk (the scheduler calls
+        this only when ``busy``). Returns (tag, DecodeResult) for every
+        request that FINISHED at this boundary — ok, deadline, or
+        ladder-exhausted failed. Raises nothing for decode-state faults."""
+        inject.fire("serve.chunk", step=self._chunk_counter)
+        finished: List[Tuple[Any, DecodeResult]] = []
+        # deadlines are checked BEFORE paying for the chunk, like the solo
+        # session's boundary check
+        now = self._clock()
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.deadline_at is not None and now >= slot.deadline_at:
+                finished.append((slot.tag, self._evict(i, "deadline")))
+        if not self.busy:
+            self._chunk_counter += 1
+            return finished
+        active = np.array([s is not None for s in self._slots])
+        active_dev = jnp.asarray(active)
+        snap = self._snapshot()
+        carry, toks = self._attempt(snap, active_dev)
+        bad = self._probe_bad(carry, active)
+        if bad:
+            carry, toks, bad = self._ladder(snap, active_dev, active, carry, toks, bad)
+            for i in sorted(bad):  # ladder exhausted: fail those requests
+                finished.append((self._slots[i].tag, self._evict(i, "failed")))
+                active[i] = False
+        self._carry = carry
+        done_np = self._done_np
+        for i, slot in enumerate(self._slots):
+            if slot is None or not active[i]:
+                continue
+            slot.toks.append((toks, i))
+            slot.n_emitted += self.chunk
+            slot.chunks += 1
+            if slot.n_emitted >= slot.request.max_new_tokens or done_np[i]:
+                finished.append((slot.tag, self._evict(i, "ok")))
+        self._chunk_counter += 1
+        return finished
+
+    def _snapshot(self):
+        """Container-fresh snapshot of the batched carry (O(1): jax arrays
+        are immutable; the rewind target must not alias mutated dicts —
+        the same contract as the solo session's
+        ``transformer.snapshot_decode_state``)."""
+        token, states, t, emit, done = self._carry
+        return (token, snapshot_decode_state(states), t, emit, done)
+
+    def _attempt(self, carry, active_dev):
+        """One batched chunk attempt; applies any armed per-slot (or
+        legacy per-chunk) decode-state poisoning afterwards so each ladder
+        rung is deterministically reachable per slot."""
+        out, toks = decode_batched_chunk(
+            self.model, self.params, carry, self._rngs, active_dev,
+            self.chunk, self._sample,
+        )
+        if inject.active():
+            for i, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                if inject.decode_slot_nan_armed(i, slot.chunks) or (
+                    inject.decode_nan_armed(slot.chunks)
+                ):
+                    out = self._poison_slot(out, i)
+        return out, toks
+
+    @staticmethod
+    def _poison_slot(carry, i: int):
+        token, states, t, emit, done = carry
+        states = jax.tree.map(
+            lambda x: x.at[i].set(jnp.nan)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            states,
+        )
+        return (token, states, t, emit, done)
+
+    def _probe_bad(self, carry, active: np.ndarray) -> set:
+        """The designated per-chunk host sync: ONE [2, slots]-bool
+        transfer carrying the per-slot finite mask (free slots masked — a
+        failed request's NaN remains in its row until the next admission
+        overwrites it) AND the done flags (EOS already emitted -> every
+        later token is PAD, so the slot can be freed and the tail filled
+        host-side); the done row is stashed for the eviction pass."""
+        flags = np.asarray(_slot_flags(carry[1], carry[4]))
+        self._done_np = flags[1]
+        finite = flags[0]
+        return {i for i in range(self.slots) if active[i] and not finite[i]}
+
+    def _ladder(self, snap, active_dev, active, carry, toks, bad):
+        """Walk the per-slot degradation ladder. Redoing the WHOLE batched
+        chunk from the boundary snapshot is the rewind: deterministic
+        row-independent compute means untouched slots reproduce their
+        tokens bitwise, and the poisoned slot gets its retry. Returns the
+        accepted (carry, toks) and the set of slots whose ladder is
+        exhausted (their requests fail; everyone else streams on)."""
+        # rung 1: rewind — redo from the snapshot
+        carry, toks = self._attempt(snap, active_dev)
+        bad2 = self._probe_bad(carry, active)
+        for i in bad:
+            self._slots[i].rewinds += 1
+        if not bad2:
+            return carry, toks, set()
+        # rung 2: the snapshot itself is poisoned for the still-bad slots —
+        # rebuild each from its prompt + emitted tokens (the one thing
+        # known good), row-write into the snapshot, redo
+        snap2 = snap
+        for i in sorted(bad2):
+            snap2 = self._reprefill_into(snap2, i)
+            self._slots[i].reprefills += 1
+        carry, toks = self._attempt(snap2, active_dev)
+        bad3 = self._probe_bad(carry, active)
+        if not bad3:
+            return carry, toks, set()
+        # rung 3: fail the exhausted slots and redo once more with them
+        # masked out, so the surviving slots still get their chunk
+        still = np.array(active)
+        for i in bad3:
+            still[i] = False
+        if still.any():
+            carry, toks = self._attempt(snap2, jnp.asarray(still))
+        return carry, toks, bad3
+
+    def _reprefill_into(self, snap, i: int):
+        """Ladder rung 2 for slot ``i``: solo re-prefill of prompt + the
+        tokens emitted so far (the shared :func:`generate.reprefill_carry`
+        — identical rng/done alignment to the solo session's rung),
+        row-written over the slot's poisoned snapshot state."""
+        slot = self._slots[i]
+        emitted = [arr[row : row + 1] for arr, row in slot.toks]
+        rng = jax.random.PRNGKey(slot.request.seed)
+        sub = reprefill_carry(
+            self.model, self.params, slot.prompt, emitted, self._sample,
+            rng, buckets=self.buckets,
+        )
+        new_snap, self._rngs = _insert_carry(
+            snap, self._rngs, sub, rng,
+            jnp.int32(i), jnp.int32(slot.n_emitted),
+        )
+        return new_snap
+
+    # -- eviction -------------------------------------------------------------
+
+    def _evict(self, i: int, status: str) -> DecodeResult:
+        """Free slot ``i`` and materialize its request's result — the one
+        sync per REQUEST lifetime (not per chunk), outside the scheduler's
+        per-chunk probe budget. Emitted tokens are trimmed to
+        max_new_tokens (the engine always runs whole chunks) and an
+        early-EOS eviction PAD-fills the tail, exactly what the solo scan
+        would have emitted."""
+        slot = self._slots[i]
+        self._slots[i] = None
+        req = slot.request
+        want = req.max_new_tokens
+        if slot.toks:
+            tokens = np.concatenate(
+                [np.asarray(arr)[row : row + 1] for arr, row in slot.toks],
+                axis=1,
+            )[:, :want]
+        else:
+            tokens = np.zeros((1, 0), np.int32)
+        n = tokens.shape[1]
+        if status == "ok" and n < want:
+            pad = np.full((1, want - n), req.sample.pad_token, tokens.dtype)
+            tokens = np.concatenate([tokens, pad], axis=1)
+            n = want
+        return DecodeResult(
+            tokens=tokens,
+            status=status,
+            new_tokens=n,
+            chunks=slot.chunks,
+            rewinds=slot.rewinds,
+            reprefills=slot.reprefills,
+        )
+
+    def drain_evict_all(self, status: str = "failed") -> List[Tuple[Any, DecodeResult]]:
+        """Forcibly evict every resident request with partial tokens (the
+        Server's last-resort path when the loop must exit NOW; the normal
+        SIGTERM drain finishes slots instead)."""
+        out = []
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                out.append((slot.tag, self._evict(i, status)))
+        return out
+
+
+__all__ = ["SlotEngine", "parse_buckets"]

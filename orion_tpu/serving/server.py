@@ -1,27 +1,39 @@
-"""Bounded-admission request server over a DecodeSession.
+"""Bounded-admission continuous-batching server over a SlotEngine.
 
 The serving counterpart of the trainer's resilience stack (PR 2): the
 same primitives — PreemptionGuard, Watchdog, retry, fault hooks — wired
-around the decode path instead of the step loop.
+around the decode path instead of the step loop. Since PR 5 the serve
+loop is a SCHEDULER over the slot-multiplexed batched decode engine
+(:class:`~orion_tpu.serving.batching.SlotEngine`): up to ``slots``
+requests decode concurrently in one jitted scan, and admission, drain,
+deadlines, and watchdog beats all happen at chunk boundaries.
 
-- **admission** — a bounded queue (``max_inflight``); a full queue SHEDS
-  the request with :class:`OverloadError` at submit time instead of
-  growing an unbounded backlog whose tail latency is all deadline misses
-  anyway. A draining/dead server REJECTS with :class:`RejectedError`.
+- **admission** — a bounded queue (``max_inflight`` bounds the QUEUED
+  backlog; up to ``slots`` more are resident in the engine); a full
+  queue SHEDS the request with :class:`OverloadError` at submit time
+  instead of growing an unbounded backlog whose tail latency is all
+  deadline misses anyway. A draining/dead server REJECTS with
+  :class:`RejectedError`. Queued requests move into free slots at every
+  chunk boundary — a late arrival joins mid-stream at its own position
+  without waiting for the batch to drain.
 - **health** — the :class:`~orion_tpu.serving.health.HealthMachine`
   drives admission: SERVING/DEGRADED accept, DRAINING/DEAD reject.
   Requests that needed the degradation ladder (or a watchdog stall) move
   SERVING -> DEGRADED; a clean completion recovers to SERVING.
 - **SIGTERM** — the PreemptionGuard installed around the serve loop maps
-  the first signal to DRAINING at the next chunk boundary: in-flight and
-  already-admitted requests complete, new submits are rejected, the loop
-  exits 0. A second signal kills, as everywhere else in the stack.
+  the first signal to DRAINING at the next chunk boundary: in-flight
+  slots AND already-admitted requests complete, new submits are
+  rejected, the loop exits 0. A second signal kills, as everywhere else
+  in the stack.
 - **watchdog** — ``stall_timeout`` arms a heartbeat watchdog beaten at
   every chunk boundary; a stalled chunk (wedged DMA, deadlocked
   collective) degrades health and writes a diagnosis instead of hanging
   the replica silently.
-- **request isolation** — a request that raises is recorded on its
-  Pending and counted; the process never dies for one request.
+- **request isolation** — a request the engine cannot multiplex (batch
+  > 1, over-capacity prompt, mismatched SampleConfig) or whose slot
+  exhausts the per-slot degradation ladder becomes an error/failed
+  RESULT on its Pending; co-resident slots keep streaming and the
+  process never dies for one request.
 """
 
 from __future__ import annotations
@@ -34,16 +46,14 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+import numpy as np
+
 from orion_tpu.resilience.inject import fire
 from orion_tpu.resilience.preempt import PreemptionGuard
 from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
 from orion_tpu.resilience.watchdog import Watchdog
 from orion_tpu.serving.health import Health, HealthMachine
-from orion_tpu.serving.session import (
-    DecodeRequest,
-    DecodeResult,
-    DecodeSession,
-)
+from orion_tpu.serving.session import DecodeRequest, DecodeResult
 
 
 class OverloadError(RuntimeError):
@@ -57,24 +67,28 @@ class RejectedError(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
     chunk: int = 16  # decode chunk length (deadline/abort granularity)
-    max_inflight: int = 8  # admission bound: queued + running requests
+    slots: int = 8  # concurrent decode slots (one batched-scan row each)
+    max_inflight: int = 8  # admission bound on the QUEUED backlog
     deadline_ms: float = 0.0  # default per-request deadline (0 = none)
     stall_timeout: float = 0.0  # watchdog heartbeat budget (0 = off)
     grace: float = 30.0  # SIGTERM drain budget, as in training
     poll: float = 0.05  # idle queue poll cadence (seconds)
+    prefill_buckets: str = "pow2"  # pad-to-bucket prompt lengths ("" = off)
 
 
 @dataclasses.dataclass
 class Pending:
-    """A submitted request's slot; ``done`` is set exactly once, with
+    """A submitted request's handle; ``done`` is set exactly once, with
     either ``result`` or ``error`` filled. ``admitted_at`` anchors the
-    request's deadline: queue wait counts against the budget."""
+    request's deadline: queue wait counts against the budget;
+    ``done_at`` records completion (the serving bench's latency stamp)."""
 
     request: DecodeRequest
     done: threading.Event
     admitted_at: float = 0.0
     result: Optional[DecodeResult] = None
     error: Optional[Exception] = None
+    done_at: float = 0.0
 
     def wait(self, timeout: Optional[float] = None) -> Optional[DecodeResult]:
         """Block for the outcome: returns the DecodeResult, RAISES the
@@ -111,8 +125,9 @@ def load_tokenizer(path: Optional[str] = None, retry: Optional[RetryPolicy] = No
 
 
 class Server:
-    """Single-worker serve loop (decode serializes on the device anyway);
-    ``submit`` is thread-safe and may be called from feeder threads."""
+    """Single-worker scheduler loop (decode serializes on the device
+    anyway); ``submit`` is thread-safe and may be called from feeder
+    threads."""
 
     def __init__(
         self,
@@ -121,10 +136,15 @@ class Server:
         cfg: ServeConfig = ServeConfig(),
         clock: Callable[[], float] = time.monotonic,
     ):
+        from orion_tpu.serving.batching import SlotEngine, parse_buckets
+
         self.cfg = cfg
         self._clock = clock
-        self.session = DecodeSession(
-            model, params, chunk=cfg.chunk, clock=clock
+        self.engine = SlotEngine(
+            model, params, slots=cfg.slots, chunk=cfg.chunk, clock=clock,
+            prefill_buckets=parse_buckets(
+                cfg.prefill_buckets, model.cfg.max_seq_len
+            ),
         )
         self.health = HealthMachine(clock=clock)
         self._q: "queue.Queue[Pending]" = queue.Queue(maxsize=cfg.max_inflight)
@@ -141,6 +161,7 @@ class Server:
             "admitted": 0, "shed": 0, "rejected": 0,
             "ok": 0, "deadline": 0, "failed": 0,
             "rewinds": 0, "reprefills": 0, "stalls": 0,
+            "chunks": 0, "slot_steps_active": 0, "slot_steps_total": 0,
         }
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -170,7 +191,8 @@ class Server:
             except queue.Full:
                 self._bump("shed")
                 raise OverloadError(
-                    f"admission queue full ({self.cfg.max_inflight} in flight)"
+                    f"admission queue full ({self.cfg.max_inflight} queued "
+                    f"+ up to {self.cfg.slots} resident in slots)"
                 ) from None
         self._bump("admitted")
         return pending
@@ -206,20 +228,43 @@ class Server:
             self._guard = guard
             if self.health.state is Health.STARTING:
                 self.health.to(Health.SERVING, "serve loop running")
+            clean_exit = False
             try:
+                # the scheduler: admit queued requests into free slots,
+                # advance every resident slot one chunk, complete the
+                # finished — all at chunk-boundary granularity. DRAINING
+                # still admits the already-queued backlog (PR 4's drain
+                # contract: in-flight AND admitted requests complete);
+                # only submit() is closed.
                 while True:
                     self._maybe_drain(guard)
                     draining = self.health.state is Health.DRAINING
-                    if draining and self._q.empty():
-                        break
-                    try:
-                        pending = self._q.get(timeout=cfg.poll)
-                    except queue.Empty:
-                        if drain_when_idle:
+                    self._admit_from_queue(wd)
+                    if not self.engine.busy:
+                        if (draining or drain_when_idle) and self._q.empty():
                             break
+                        try:
+                            pending = self._q.get(timeout=cfg.poll)
+                        except queue.Empty:
+                            continue
+                        self._admit(pending, wd)
                         continue
-                    self._run_one(pending, wd, guard)
+                    self._step_chunk(wd, guard)
+                clean_exit = True
             finally:
+                if not clean_exit:
+                    # the loop RAISED mid-chunk (device OOM, runtime
+                    # error): keep the done-exactly-once contract
+                    # _run_one's finally used to give — a Pending whose
+                    # event never fires hangs its caller forever. Resident
+                    # slots complete as 'failed' with their partial
+                    # tokens; still-QUEUED Pendings are rejected loudly
+                    # (the loop that would have served them is dead).
+                    for pending, result in self.engine.drain_evict_all(
+                        "failed"
+                    ):
+                        self._complete(pending, result)
+                    self._reject_leftovers()
                 if wd is not None:
                     wd.close()
                 self._guard = None
@@ -242,46 +287,97 @@ class Server:
             if self.health.state is not Health.DEAD:
                 self.health.to(Health.DEAD, "closed")
 
-    # -- internals ------------------------------------------------------------
+    # -- scheduler internals --------------------------------------------------
 
-    def _run_one(self, pending: Pending, wd, guard) -> None:
+    def _admit_from_queue(self, wd=None) -> None:
+        """Move queued requests into free slots (called at every chunk
+        boundary — this is where a late arrival joins the running batch)."""
+        while self.engine.has_free_slot:
+            try:
+                pending = self._q.get_nowait()
+            except queue.Empty:
+                return
+            self._admit(pending, wd)
+
+    def _admit(self, pending: Pending, wd=None) -> None:
+        """Place one Pending into a slot: solo prefill + row insert. A
+        request whose whole deadline elapsed in the queue completes as
+        'deadline' with zero tokens (no prefill paid); one the engine
+        cannot multiplex becomes an error RESULT (isolation) — the batch
+        keeps streaming either way."""
         if wd is not None:
-            wd.beat("request start")
-
-        def on_chunk(chunk_idx: int) -> None:
-            if wd is not None:
-                wd.beat("decode chunk")
-            self._maybe_drain(guard)
-
+            # a cold-start admission burst runs up to `slots` solo
+            # prefills (each possibly a fresh bucket compile) before the
+            # next chunk beat — without a beat per admission that wait
+            # reads as a stall on a healthy replica
+            wd.beat("request admission")
         deadline_at = (
             pending.admitted_at + pending.request.deadline_ms / 1000.0
             if pending.request.deadline_ms > 0
             else None
         )
+        if deadline_at is not None and self._clock() >= deadline_at:
+            self._complete(pending, DecodeResult(
+                tokens=np.zeros((1, 0), np.int32), status="deadline",
+                new_tokens=0, chunks=0,
+            ))
+            return
         try:
-            result = self.session.run(
-                pending.request, on_chunk=on_chunk, deadline_at=deadline_at
-            )
+            self.engine.admit(pending.request, tag=pending, deadline_at=deadline_at)
         except Exception as e:
-            # request isolation: a raising request is an error RESULT,
-            # never a dead process
+            # request isolation: an unadmittable request is an error
+            # RESULT, never a dead process (and never a stuck batch)
             pending.error = e
             self._bump("failed")
-            self._degrade(f"request raised {type(e).__name__}: {e}")
-        else:
-            pending.result = result
-            self._bump(result.status)
-            self._bump("rewinds", result.rewinds)
-            self._bump("reprefills", result.reprefills)
-            if result.status == "failed" or result.degraded:
-                self._degrade(
-                    f"request needed the ladder (rewinds={result.rewinds}, "
-                    f"reprefills={result.reprefills}, status={result.status})"
-                )
-            elif self.health.state is Health.DEGRADED:
-                self.health.to(Health.SERVING, "clean request completed")
-        finally:
+            self._degrade(f"request refused: {type(e).__name__}: {e}")
+            pending.done_at = self._clock()
             pending.done.set()
+
+    def _step_chunk(self, wd, guard) -> None:
+        """One engine boundary: watchdog beat, advance all slots a chunk,
+        complete whatever finished, refresh the occupancy gauges."""
+        if wd is not None:
+            wd.beat("decode chunk")
+        self._maybe_drain(guard)
+        occupied = self.engine.active_count
+        finished = self.engine.step()
+        with self._stats_lock:
+            self.stats["chunks"] += 1
+            self.stats["slot_steps_active"] += occupied
+            self.stats["slot_steps_total"] += self.engine.slots
+        for pending, result in finished:
+            self._complete(pending, result)
+
+    def _complete(self, pending: Pending, result: DecodeResult) -> None:
+        pending.result = result
+        self._bump(result.status)
+        self._bump("rewinds", result.rewinds)
+        self._bump("reprefills", result.reprefills)
+        if result.status == "failed" or result.degraded:
+            self._degrade(
+                f"request needed the ladder (rewinds={result.rewinds}, "
+                f"reprefills={result.reprefills}, status={result.status})"
+            )
+        elif self.health.state is Health.DEGRADED:
+            self.health.to(Health.SERVING, "clean request completed")
+        pending.done_at = self._clock()
+        pending.done.set()
+
+    def occupancy(self) -> float:
+        """Fraction of slot-chunks that carried a live request (1.0 =
+        perfectly packed) — the continuous-batching utilization gauge."""
+        with self._stats_lock:
+            total = self.stats["slot_steps_total"]
+            return self.stats["slot_steps_active"] / total if total else 0.0
+
+    def snapshot(self) -> dict:
+        """Health + scheduler gauges in one payload (the /healthz body)."""
+        snap = self.health.snapshot()
+        with self._stats_lock:
+            snap["stats"] = dict(self.stats)
+        snap["occupancy"] = self.occupancy()
+        snap["slots"] = self.engine.occupancy()
+        return snap
 
     def _maybe_drain(self, guard) -> None:
         if guard is not None and guard.should_stop and self.health.state in (

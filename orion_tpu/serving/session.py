@@ -46,7 +46,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from orion_tpu.generate import SampleConfig, decode_chunk, prefill_carry
+from orion_tpu.generate import (
+    SampleConfig,
+    decode_chunk,
+    prefill_carry,
+    reprefill_carry,
+)
 from orion_tpu.models.transformer import (
     decode_state_finite,
     snapshot_decode_state,
@@ -141,20 +146,12 @@ class DecodeSession:
 
     def _reprefill(self, prompt, emitted: List[Array], n: int, sample, rng):
         """Ladder rung 2: rebuild the decode carry by re-prefilling the
-        prompt plus the ``n`` tokens emitted so far. ``sample_index=n``
-        keeps the rng fold_in sequence aligned with the uninterrupted
-        walk; ``done`` is recomputed from the emitted tokens."""
-        seq = (
-            jnp.concatenate([prompt] + list(emitted), axis=1)
-            if emitted
-            else prompt
-        )
-        done = None
-        if sample.eos_token >= 0:
-            done = (seq[:, prompt.shape[1]:] == sample.eos_token).any(axis=1)
-        return prefill_carry(
-            self.model, self.params, seq, sample, rng,
-            sample_index=n, done=done,
+        prompt plus the ``n`` tokens emitted so far (the shared
+        :func:`generate.reprefill_carry` — one definition of the rung's
+        rng/done alignment for the solo and slot-multiplexed paths)."""
+        del n  # implied by the emitted tokens
+        return reprefill_carry(
+            self.model, self.params, prompt, emitted, sample, rng
         )
 
     def _chunk_with_ladder(

@@ -403,6 +403,48 @@ def _probe_all_finite(carries):
     )
 
 
+def test_decode_host_sync_budgets_one_probe_per_chunk_loop():
+    """The probe exemption is itself budgeted for the scheduler loop:
+    ONE probe sync per chunk regardless of slot count. Two probe calls in
+    one loop body, or a probe inside a per-slot loop nested in the chunk
+    loop, are findings; the single-probe scheduler shape is clean."""
+    # clean: the continuous-batching scheduler's shape — one probe call
+    # per chunk-loop iteration, however many slots are resident
+    clean = """
+def schedule(engine):
+    while engine.busy:
+        flags = engine._probe_slots()
+        engine.evict(flags)
+"""
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(clean, path="orion_tpu/serving/batching.py")
+    )
+    # two probe calls per chunk loop = two device round-trips per chunk
+    double = """
+def schedule(engine):
+    while engine.busy:
+        finite = engine._probe_finite()
+        done = engine._probe_done()
+"""
+    assert "decode-host-sync" in rule_ids(
+        lint_source(double, path="orion_tpu/serving/batching.py")
+    )
+    # the per-slot-probe shape: syncs slot-count times per chunk
+    nested = """
+def schedule(engine, slots):
+    while engine.busy:
+        for i in range(slots):
+            engine._probe_slot(i)
+"""
+    assert "decode-host-sync" in rule_ids(
+        lint_source(nested, path="orion_tpu/serving/batching.py")
+    )
+    # outside the decode modules the budget does not apply
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(double, path="orion_tpu/evaluate.py")
+    )
+
+
 def test_loop_accum_only_fires_on_hot_paths():
     src = """
 import jax.numpy as jnp
