@@ -470,6 +470,93 @@ def bench_serve(
     top = out["rows"].get(f"slots{slot_counts[-1]}", {}).get("tokens_per_sec")
     if base and top:
         out["speedup_tokens_per_sec"] = round(top / base, 3)
+    try:
+        out["sessions"] = bench_session_admission(
+            model, params, chunk=chunk, history_new=max_new, reps=reps,
+        )
+        print(json.dumps({"serve_sessions": out["sessions"]}),
+              file=sys.stderr)
+    except Exception as e:  # the slot rows are still a valid artifact
+        print(json.dumps({"serve_sessions_error": repr(e)}), file=sys.stderr)
+    _free_device_memory()
+    return out
+
+
+def bench_session_admission(model, params, chunk: int = 4,
+                            history_new: int = 256, prompt_len: int = 8,
+                            reps: int = 5) -> dict:
+    """Durable-session row: what does RE-ADMITTING a conversation cost?
+
+    Three medians (ms), all on the same engine and history length:
+
+    - ``suspend_ms`` — extract the slot's O(1) carry row to host (the
+      drain/idle-eviction cost per conversation);
+    - ``resume_admit_ms`` — row-insert the saved state back at its
+      position and rng-fold index: O(1) in the conversation length, the
+      paper's whole point (a softmax-KV server ships megabytes per
+      session or re-prefills);
+    - ``reprefill_admit_ms`` — the alternative a state-less server pays:
+      prefill prompt + every emitted token (O(history)), measured on the
+      exact-length compile after a warm pass.
+
+    The ratio is the admission-cost row BENCH_SERVE.json reports; it
+    GROWS with conversation length while resume stays flat."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orion_tpu.generate import SampleConfig, prefill_carry
+    from orion_tpu.serving import DecodeRequest, SlotEngine
+
+    sample = SampleConfig(temperature=0.0)
+    prompt = jnp.ones((1, prompt_len), jnp.int32)
+    eng = SlotEngine(model, params, slots=2, chunk=chunk)
+    eng.admit(
+        DecodeRequest(prompt=prompt, max_new_tokens=history_new,
+                      sample=sample, seed=0, session_id="bench"),
+        tag="t0",
+    )
+    done = {}
+    while eng.busy:
+        done.update(dict(eng.step()))
+    sess = done["t0"].session
+    cont = DecodeRequest(prompt=np.zeros((1, 0), np.int32),
+                         max_new_tokens=chunk, sample=sample, seed=0,
+                         session_id="bench")
+    resume_ms, suspend_ms = [], []
+    for _ in range(max(reps, 3) + 1):  # first lap warms the jit entries
+        t0 = time.perf_counter()
+        eng.resume(sess, cont, tag="t")
+        jax.block_until_ready(eng._carry)
+        t1 = time.perf_counter()
+        [(_, res)] = eng.suspend_sessions()  # includes the host transfer
+        t2 = time.perf_counter()
+        sess = res.session
+        resume_ms.append((t1 - t0) * 1e3)
+        suspend_ms.append((t2 - t1) * 1e3)
+    resume_ms, suspend_ms = sorted(resume_ms[1:]), sorted(suspend_ms[1:])
+    full = jnp.concatenate(
+        [jnp.asarray(sess.prompt), jnp.asarray(sess.emitted)], axis=1
+    )
+    reprefill_ms = []
+    for i in range(max(reps, 3) + 1):
+        t0 = time.perf_counter()
+        jax.block_until_ready(prefill_carry(
+            model, params, full, sample, jax.random.PRNGKey(0),
+            sample_index=int(sess.emit),
+        ))
+        reprefill_ms.append((time.perf_counter() - t0) * 1e3)
+    reprefill_ms = sorted(reprefill_ms[1:])
+    med = lambda xs: round(xs[len(xs) // 2], 3)  # noqa: E731
+    out = {
+        "history_len": int(full.shape[1]),
+        "suspend_ms": med(suspend_ms),
+        "resume_admit_ms": med(resume_ms),
+        "reprefill_admit_ms": med(reprefill_ms),
+    }
+    out["reprefill_over_resume"] = round(
+        out["reprefill_admit_ms"] / max(out["resume_admit_ms"], 1e-9), 2
+    )
     return out
 
 

@@ -18,10 +18,12 @@ from orion_tpu.analysis.rules import (
     jit_hygiene,
     pallas_guards,
     perf,
+    persist,
 )
 
 ALL_RULES: Dict[str, object] = {}
-for _mod in (jit_hygiene, perf, hygiene, pallas_guards, concurrency, decode):
+for _mod in (jit_hygiene, perf, hygiene, pallas_guards, concurrency, decode,
+             persist):
     for _rule in _mod.RULES:
         assert _rule.id not in ALL_RULES, f"duplicate rule id {_rule.id}"
         ALL_RULES[_rule.id] = _rule

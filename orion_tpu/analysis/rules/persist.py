@@ -1,0 +1,102 @@
+"""Durability-hygiene rules for state that must survive a kill.
+
+``non-atomic-persist`` — a state file written in place (``open(path, "w")``
+                     + dump) is torn by any kill mid-write: the next
+                     process reads half a JSON and dies — or worse,
+                     silently mis-parses. Every state publish under the
+                     serving//resilience//training subtrees must use the
+                     write-tmp-then-``os.replace`` idiom (one helper:
+                     ``training/checkpoint.py::atomic_write_json``), which
+                     makes the rename the commit point: readers see the
+                     previous complete file or the new complete file,
+                     never a prefix. This is the invariant the whole
+                     durable-session / checkpoint-manifest fault model
+                     leans on — the chaos tests kill writers mid-save and
+                     expect the previous generation intact.
+
+Heuristics (AST-only): a ``open(..., "w"/"wb"/"w+")`` call (positional or
+``mode=`` keyword, string literal) inside one of the persistence subtrees
+is a finding unless the enclosing function also calls ``os.replace`` /
+``os.rename`` (the tmp-write of the idiom lives in the same function as
+its publishing rename). Append mode is exempt — an append-only log
+(metrics jsonl) is prefix-valid by construction, no rename can help it.
+Reads are exempt. Test code is exempt. Real exceptions use the standard
+``# orion: noqa[non-atomic-persist]`` / baseline escape hatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from orion_tpu.analysis.findings import Finding
+from orion_tpu.analysis.lint import ModuleContext, dotted_name
+
+_PERSIST_SUBTREES = ("serving/", "resilience/", "training/")
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode string of an ``open`` call iff it truncate-writes
+    ('w' anywhere in the mode); None for reads, appends, r+ updates, or
+    non-literal modes (no type info — don't guess)."""
+    mode_node = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return None
+    if not (isinstance(mode_node, ast.Constant)
+            and isinstance(mode_node.value, str)):
+        return None
+    return mode_node.value if "w" in mode_node.value else None
+
+
+class NonAtomicPersistRule:
+    id = "non-atomic-persist"
+    title = "state file written without write-tmp-then-os.replace"
+
+    def _enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = getattr(node, "_orion_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = getattr(cur, "_orion_parent", None)
+        return None
+
+    @staticmethod
+    def _has_publish_rename(scope: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call) and dotted_name(node.func) in (
+                "os.replace", "os.rename",
+            ):
+                return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.is_test:
+            return
+        if not any(s in ctx.path for s in _PERSIST_SUBTREES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and dotted_name(node.func) == "open"):
+                continue
+            mode = _write_mode(node)
+            if mode is None:
+                continue
+            scope = self._enclosing_function(node) or ctx.tree
+            if self._has_publish_rename(scope):
+                continue  # the write-tmp-then-replace idiom
+            yield Finding(
+                self.id, ctx.path, node.lineno,
+                f"open(..., {mode!r}) publishes a state file in place: a "
+                "kill mid-write leaves a torn file the next process "
+                "chokes on — write a sibling .tmp and os.replace it into "
+                "place (training/checkpoint.py::atomic_write_json), or "
+                "suppress with # orion: noqa[non-atomic-persist]",
+            )
+
+
+RULES = [NonAtomicPersistRule()]

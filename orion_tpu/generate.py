@@ -185,6 +185,7 @@ def reprefill_carry(
     sample_cfg: SampleConfig,
     rng: Array,
     buckets: Tuple[int, ...] = (),
+    sample_index: Optional[int] = None,
 ):
     """Rebuild a decode carry from prompt + the tokens already emitted —
     the degradation ladder's re-prefill rung, shared by the solo
@@ -193,14 +194,21 @@ def reprefill_carry(
     with the uninterrupted walk, and ``done`` is recomputed from the
     emitted tokens (rows that already hit EOS stay done).
 
+    ``sample_index`` overrides the default fold index (= the number of
+    emitted tokens) for callers whose ``prompt`` is itself a rebased
+    context containing earlier emissions — a resumed durable session's
+    rng walk is anchored at the carry's absolute emit count, not at this
+    segment's length (serving/session_store.py).
+
     Caveat (both callers): rows that emitted EOS are rebuilt from their
     PAD-filled tail rather than the post-EOS samples the uninterrupted
     carry held — those rows keep emitting PAD either way, but their
     dead-state contents differ from an uninterrupted run's."""
     seq = (
-        jnp.concatenate([prompt] + list(emitted), axis=1)
+        jnp.concatenate([jnp.asarray(prompt, jnp.int32)]
+                        + [jnp.asarray(e, jnp.int32) for e in emitted], axis=1)
         if emitted
-        else prompt
+        else jnp.asarray(prompt, jnp.int32)
     )
     n = seq.shape[1] - prompt.shape[1]
     done = None
@@ -208,7 +216,8 @@ def reprefill_carry(
         done = (seq[:, prompt.shape[1]:] == sample_cfg.eos_token).any(axis=1)
     return prefill_carry(
         model, params, seq, sample_cfg, rng,
-        sample_index=n, done=done, buckets=buckets,
+        sample_index=n if sample_index is None else sample_index,
+        done=done, buckets=buckets,
     )
 
 

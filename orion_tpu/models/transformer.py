@@ -826,10 +826,14 @@ def insert_decode_slot(
 
 def extract_decode_slot(states: List[State], i: Array) -> List[State]:
     """Row ``i`` of the batched decode state as a batch-of-1 state pytree —
-    the inverse of :func:`insert_decode_slot`, for diagnostics and the
-    round-trip tests (the engine itself never extracts: its re-prefill
-    rung rebuilds state from the emitted tokens instead, since a poisoned
-    row is exactly what it must NOT reuse)."""
+    the inverse of :func:`insert_decode_slot`. This is the SUSPEND half of
+    the durable-session round trip (serving/session_store.py): the row is
+    pulled to host at a chunk boundary and later re-inserted at the saved
+    position and rng-fold index, bitwise-identical to having stayed
+    resident (insert(extract(i)) is identity by construction — only ever
+    called on a state the per-slot finite probe just passed; the ladder's
+    re-prefill rung still rebuilds from tokens, since a POISONED row is
+    exactly what it must not reuse)."""
     return jax.tree.map(
         lambda x: jax.lax.dynamic_slice_in_dim(x, i, 1, axis=0), states
     )

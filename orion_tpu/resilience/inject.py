@@ -39,12 +39,25 @@ Hook sites wired today:
                           to poison ONLY slot K's rows of the batched decode
                           state at that request's chunk index — the per-slot
                           ladder's chaos address
+``"serve.session_save"``  serving/session_store.py SessionStore.save, inside
+                          the retried write of one session generation
+                          (step = the generation number)
+``"serve.session_load"``  serving/session_store.py SessionStore.load, inside
+                          the retried read of one session generation
+                          (step = the generation number)
 ========================  ====================================================
+
+Every wired site is REGISTERED in :data:`SITES` (dynamic per-slot sites by
+prefix in :data:`SITE_PREFIXES`); :meth:`FaultPlan.add` rejects unknown
+names so a chaos test can't silently arm a typo that never fires, and the
+meta-test in tests/test_resilience.py asserts every registered site is
+exercised by at least one chaos test — a new hook can't rot untested.
 
 Also here: :func:`corrupt_step` / :func:`truncate_step`, which damage a
 written orbax step directory on disk the way flaky storage does — the
 integrity-verified restore path (training/checkpoint.py) is tested against
-both.
+both — and their session-store analogues :func:`corrupt_session` /
+:func:`truncate_session` (serving/session_store.py restore fallback).
 """
 
 from __future__ import annotations
@@ -59,6 +72,31 @@ from typing import Callable, List, Optional
 _NAN_SITE = "train.nan"
 _DECODE_NAN_SITE = "decode.state_nan"
 _CHUNK_SITE = "serve.chunk"
+
+# The registry of every wired hook site (site -> where it fires). Keeping
+# this table beside the delivery machinery makes two guarantees cheap:
+# FaultPlan.add rejects typo'd site names at authoring time, and the
+# chaos-coverage meta-test (tests/test_resilience.py) can assert each
+# entry is exercised by at least one chaos test.
+SITES = {
+    "ckpt.save": "training/checkpoint.py maybe_save, inside retry",
+    "ckpt.restore": "training/checkpoint.py restore, inside retry",
+    "data.batch": "training/data.py prefetch worker, inside retry",
+    "train.step_boundary": "trainer loop, each step boundary",
+    "train.nan": "Trainer.step NaN-gradient poisoning marker",
+    "serve.ckpt_load": "generate.load_params, inside retry",
+    "serve.tokenizer_io": "serving/server.py tokenizer load, inside retry",
+    "serve.chunk": "serving decode loops, each chunk boundary",
+    "decode.state_nan": "DecodeSession decode-state poisoning marker",
+    "serve.session_save": "serving/session_store.py save, inside retry",
+    "serve.session_load": "serving/session_store.py load, inside retry",
+}
+# dynamically-addressed site families (matched by prefix)
+SITE_PREFIXES = ("decode.slot_nan.",)
+
+
+def known_site(site: str) -> bool:
+    return site in SITES or site.startswith(SITE_PREFIXES)
 
 
 def _decode_slot_site(slot: int) -> str:
@@ -93,6 +131,12 @@ class FaultPlan:
         times: int = 1,
         action: Optional[Callable[[], None]] = None,
     ) -> "FaultPlan":
+        if not known_site(site):
+            raise ValueError(
+                f"unknown fault-injection site {site!r}: a fault armed at a "
+                "site no hook fires never delivers — register it in "
+                "inject.SITES (and cover it in a chaos test) first"
+            )
         self._faults.append(_Fault(site, step, times, action))
         return self
 
@@ -277,8 +321,57 @@ def truncate_step(ckpt_dir: str, step: int) -> List[str]:
     return [target]
 
 
+# -- on-disk session corruption (test control, not a hook) --------------------
+
+
+def _session_gen_bin(session_dir: str, session_id: str,
+                     generation: Optional[int]) -> str:
+    """Path of one session generation's payload file (default: newest)."""
+    d = os.path.join(session_dir, session_id)
+    gens = sorted(
+        int(n[len("gen-"):-len(".bin")])
+        for n in os.listdir(d)
+        if n.startswith("gen-") and n.endswith(".bin")
+    )
+    if not gens:
+        raise FileNotFoundError(f"no session generations under {d}")
+    g = generation if generation is not None else gens[-1]
+    return os.path.join(d, f"gen-{g:06d}.bin")
+
+
+def corrupt_session(
+    session_dir: str, session_id: str, generation: Optional[int] = None
+) -> str:
+    """Flip bytes in the middle of a saved session generation's payload
+    (default: the newest) — the bit-rot failure the manifest's per-leaf
+    crc32 exists to catch. The restore path must fall back to the previous
+    intact generation with a loud warning, exactly like checkpoint
+    restore. Returns the damaged path."""
+    path = _session_gen_bin(session_dir, session_id, generation)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        chunk = f.read(min(64, size - size // 2))
+        f.seek(size // 2)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    return path
+
+
+def truncate_session(
+    session_dir: str, session_id: str, generation: Optional[int] = None
+) -> str:
+    """Truncate a saved session generation's payload to half — the torn
+    write a kill mid-save leaves behind when it lands between the payload
+    rename and the manifest rename. Returns the damaged path."""
+    path = _session_gen_bin(session_dir, session_id, generation)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    return path
+
+
 __all__ = [
     "FaultPlan", "inject", "active", "fire", "nan_armed",
     "decode_nan_armed", "decode_slot_nan_armed", "corrupt_step",
-    "truncate_step",
+    "truncate_step", "corrupt_session", "truncate_session",
+    "SITES", "SITE_PREFIXES", "known_site",
 ]

@@ -45,12 +45,21 @@ def call_with_retries(
     sleep: Callable[[float], None] = time.sleep,
     rng: Optional[random.Random] = None,
     describe: str = "operation",
+    should_abort: Optional[Callable[[], bool]] = None,
 ) -> T:
     """Run ``fn`` under ``policy``. Non-retryable exceptions propagate
     immediately; the last retryable one propagates after the budget is
     spent. The jitter rng defaults to a seed derived from ``describe`` so a
     given call site backs off identically run to run (determinism is the
-    whole point of this subsystem)."""
+    whole point of this subsystem).
+
+    ``should_abort``: polled after each retryable failure, BEFORE the
+    backoff sleep. When it returns True the pending exception propagates
+    immediately instead of burning the remaining retry budget — the
+    serving layer plumbs its health machine in here so a DRAINING/DEAD
+    server doesn't spend its SIGTERM grace period backing off on session
+    or checkpoint I/O nobody will wait for. The first attempt always
+    runs; aborting only cancels retries."""
     if rng is None:
         rng = random.Random(zlib.crc32(describe.encode()))
     for attempt in range(1, max(policy.attempts, 1) + 1):
@@ -58,6 +67,14 @@ def call_with_retries(
             return fn()
         except policy.retry_on as e:
             if attempt >= policy.attempts:
+                raise
+            if should_abort is not None and should_abort():
+                warnings.warn(
+                    f"{describe} failed (attempt {attempt}/{policy.attempts}: "
+                    f"{type(e).__name__}: {e}); aborting retries "
+                    "(should_abort)",
+                    stacklevel=2,
+                )
                 raise
             delay = min(
                 policy.max_delay, policy.base_delay * (2 ** (attempt - 1))
@@ -85,6 +102,7 @@ def retrying(policy: RetryPolicy = RetryPolicy(), **kw):
                 describe=kw.get("describe", fn.__qualname__),
                 sleep=kw.get("sleep", time.sleep),
                 rng=kw.get("rng"),
+                should_abort=kw.get("should_abort"),
             )
 
         return wrapped

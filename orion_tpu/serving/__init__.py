@@ -16,6 +16,10 @@ training resilience stack (orion_tpu/resilience/, PR 2).
   slots, reject new, exit 0).
 - :mod:`health`  — the validated STARTING -> SERVING <-> DEGRADED ->
   DRAINING -> DEAD process health state machine.
+- :mod:`session_store` — durable sessions: a suspended conversation is
+  one O(1) decode-state snapshot, persisted atomically with a per-leaf
+  crc32 manifest and restored bitwise (``--session-dir``; survives
+  SIGTERM drain and server restarts).
 
 ``python -m orion_tpu.serving`` is the CLI (``--slots``, ``--chunk``,
 ``--deadline-ms``, ``--max-inflight``, ``--prefill-buckets``; see README
@@ -39,10 +43,16 @@ from orion_tpu.serving.session import (
     DecodeSession,
     LadderExhausted,
 )
+from orion_tpu.serving.session_store import (
+    SessionIntegrityError,
+    SessionState,
+    SessionStore,
+)
 
 __all__ = [
     "Health", "HealthMachine", "InvalidTransition",
     "Server", "ServeConfig", "Pending", "OverloadError", "RejectedError",
     "load_tokenizer", "SlotEngine", "parse_buckets",
     "DecodeRequest", "DecodeResult", "DecodeSession", "LadderExhausted",
+    "SessionStore", "SessionState", "SessionIntegrityError",
 ]

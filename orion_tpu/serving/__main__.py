@@ -65,6 +65,20 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--stall-timeout", type=float, default=0.0,
                    help="watchdog heartbeat budget per decode chunk "
                         "(0 = off); must exceed compile + one chunk")
+    p.add_argument("--session-dir", default=None,
+                   help="durable-session store root: conversations "
+                        "suspend to one O(1) state snapshot at turn end "
+                        "(and on SIGTERM drain) and resume "
+                        "bitwise-identical across restarts")
+    p.add_argument("--session-id", default=None,
+                   help="tag prompts as turns of this conversation (line "
+                        "i gets '<id>-<i>' when several prompts are "
+                        "given); with an EMPTY prompt line (or no input "
+                        "at all) the turn resumes the saved session O(1) "
+                        "and just continues generating")
+    p.add_argument("--session-idle-s", type=float, default=300.0,
+                   help="resident session-cache idle eviction at chunk "
+                        "boundaries (state stays on disk; 0 = off)")
     p.add_argument("--grace", type=float, default=30.0,
                    help="SIGTERM drain budget (seconds)")
     p.add_argument("--temperature", type=float, default=0.8)
@@ -136,7 +150,15 @@ def _run(args, guard) -> int:
     else:
         with open(args.prompts_file) as f:
             lines = [ln.rstrip("\n") for ln in f]
-    lines = [ln for ln in lines if ln]
+    if args.session_id:
+        # empty lines are CONTINUATION turns (resume the saved session,
+        # no new tokens); without any input, synthesize one continuation
+        lines = lines or [""]
+    else:
+        lines = [ln for ln in lines if ln]
+    if args.session_id and not args.session_dir:
+        print("--session-id requires --session-dir", file=sys.stderr)
+        return 2
 
     sample = SampleConfig(
         args.temperature, args.top_k, args.top_p, eos_token=eos_token
@@ -148,8 +170,14 @@ def _run(args, guard) -> int:
             max_inflight=args.max_inflight,
             deadline_ms=args.deadline_ms, stall_timeout=args.stall_timeout,
             grace=args.grace, prefill_buckets=args.prefill_buckets,
+            session_dir=args.session_dir, session_idle_s=args.session_idle_s,
         ),
     )
+    if args.session_dir and server.session_store is not None:
+        known = server.session_store.list_sessions()
+        if known:
+            print(f"session store: {len(known)} suspended session(s) "
+                  f"restorable from {args.session_dir}", file=sys.stderr)
     completed = []  # (prompt, Pending) in submission order
     rc = 0
     for i, line in enumerate(lines):
@@ -157,11 +185,16 @@ def _run(args, guard) -> int:
             print(f"draining on signal: {len(lines) - i} prompt(s) not "
                   "submitted", file=sys.stderr)
             break
+        sid = None
+        if args.session_id:
+            sid = (args.session_id if len(lines) == 1
+                   else f"{args.session_id}-{i}")
         req = DecodeRequest(
             prompt=jnp.asarray([tok.encode(line)], jnp.int32),
             max_new_tokens=args.max_new_tokens,
             sample=sample,
             seed=args.seed + i,
+            session_id=sid,
         )
         try:
             completed.append((line, server.submit(req)))

@@ -59,12 +59,14 @@ from orion_tpu.generate import (
 )
 from orion_tpu.models.transformer import (
     decode_state_finite_per_slot,
+    extract_decode_slot,
     init_decode_state,
     insert_decode_slot,
     snapshot_decode_state,
 )
 from orion_tpu.resilience import inject
 from orion_tpu.serving.session import DecodeRequest, DecodeResult
+from orion_tpu.serving.session_store import SessionState
 
 Array = jax.Array
 
@@ -93,6 +95,23 @@ def _insert_carry(carry, rngs, sub_carry, rng, i, n_emitted):
         done.at[i].set(done1[0]),
     )
     return new_carry, rngs.at[i].set(rng)
+
+
+@jax.jit
+def _extract_carry(carry, i):
+    """Row-read slot ``i`` of the batched carry as the batch-1 sub-carry
+    shape :func:`_insert_carry` takes — the suspend half of the durable
+    session round trip (insert(extract(i)) is bitwise-identity by
+    construction). ``i`` rides traced: one compile, ever. Returns
+    (token [1], state batch-1, t [], emit [], done [1])."""
+    token, states, t, emit, done = carry
+    return (
+        jax.lax.dynamic_slice_in_dim(token, i, 1),
+        extract_decode_slot(states, i),
+        jax.lax.dynamic_index_in_dim(t, i, keepdims=False),
+        jax.lax.dynamic_index_in_dim(emit, i, keepdims=False),
+        jax.lax.dynamic_slice_in_dim(done, i, 1),
+    )
 
 
 def parse_buckets(spec: str, max_seq_len: int) -> Tuple[int, ...]:
@@ -132,6 +151,21 @@ class _Slot:
     chunks: int = 0  # request-local chunk index (fault-hook address)
     rewinds: int = 0
     reprefills: int = 0
+    # -- durable-session bookkeeping (all inert for sessionless requests) --
+    session_id: Optional[str] = None
+    seed: int = 0  # the PRNGKey seed the slot's rng stream folds from
+    # tokens emitted between `prompt` and this turn's insert point (the
+    # re-prefill rung needs the FULL history, not just this turn's chunks)
+    prior: List[Any] = dataclasses.field(default_factory=list)
+    # emitted-but-unserved tokens from the suspended carry's chunk
+    # overshoot: a continuation serves these host-side BEFORE decoding,
+    # which is what keeps turn boundaries bitwise-transparent
+    prefix: Optional[np.ndarray] = None
+    target_new: int = 0  # device tokens to decode THIS turn
+    # the carry's absolute emit (rng-fold) index at this turn's insert —
+    # fold_base + n_emitted is the fold index at any later boundary
+    fold_base: int = 0
+    served_base: int = 0  # session.served at resume (0 for fresh turns)
 
 
 class SlotEngine:
@@ -200,20 +234,43 @@ class SlotEngine:
 
     # -- admission ------------------------------------------------------------
 
+    def _claim_slot(self, sample) -> int:
+        """Shared admission validation: a free slot must exist and the
+        request's SampleConfig must match the resident batch's static
+        config (the jitted scan body's static argument)."""
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            raise RuntimeError("no free slot")
+        if self._sample is None or not self.busy:
+            self._sample = sample
+        elif sample != self._sample:
+            raise ValueError(
+                "request's SampleConfig differs from the resident batch's; "
+                "the slot scan's sampling parameters are static per batch"
+            )
+        return free[0]
+
     def admit(
         self,
         request: DecodeRequest,
         tag: Any = None,
         deadline_at: Optional[float] = None,
+        session_id: Optional[str] = None,
+        sample_index: int = 0,
+        seed: Optional[int] = None,
     ) -> int:
         """Prefill ``request`` solo and insert it into a free slot.
         Raises ValueError for requests the engine cannot multiplex (no
         free slot, batch != 1, over-capacity, or a SampleConfig differing
         from the resident batch's static config); the caller decides
-        whether that fails the request or reroutes it."""
-        free = [i for i, s in enumerate(self._slots) if s is None]
-        if not free:
-            raise RuntimeError("no free slot")
+        whether that fails the request or reroutes it.
+
+        ``session_id`` tags the slot for suspension (its final state
+        rides out on the DecodeResult); ``sample_index``/``seed`` anchor
+        the rng walk for a REBASED session turn — one whose prompt is the
+        full context (original prompt + everything emitted + new user
+        tokens) of a conversation that already folded ``sample_index``
+        draws from ``PRNGKey(seed)``."""
         prompt = jnp.asarray(request.prompt, jnp.int32)
         if prompt.ndim == 1:
             prompt = prompt[None]
@@ -228,26 +285,78 @@ class SlotEngine:
                 f"prompt {prompt.shape[1]} + new {request.max_new_tokens} "
                 f"exceeds max_seq_len {cap}"
             )
-        if self._sample is None or not self.busy:
-            self._sample = request.sample
-        elif request.sample != self._sample:
-            raise ValueError(
-                "request's SampleConfig differs from the resident batch's; "
-                "the slot scan's sampling parameters are static per batch"
-            )
-        i = free[0]
-        rng = jax.random.PRNGKey(request.seed)
+        i = self._claim_slot(request.sample)
+        if session_id is None:
+            session_id = request.session_id
+        seed = request.seed if seed is None else seed
+        rng = jax.random.PRNGKey(seed)
         sub = prefill_carry(
             self.model, self.params, prompt, self._sample, rng,
-            buckets=self.buckets,
+            sample_index=sample_index, buckets=self.buckets,
         )
-        self._insert(i, sub, rng)
+        self._insert(i, sub, rng, n_emitted=sample_index)
         self._slots[i] = _Slot(
             request=request,
             tag=tag,
             deadline_at=deadline_at,
             prompt=prompt,
             toks=[],
+            session_id=session_id,
+            seed=seed,
+            target_new=request.max_new_tokens,
+            fold_base=sample_index,
+        )
+        return i
+
+    def resume(
+        self,
+        sess: SessionState,
+        request: DecodeRequest,
+        tag: Any = None,
+        deadline_at: Optional[float] = None,
+    ) -> int:
+        """Re-admit a suspended session into a free slot: O(1) row insert
+        of the saved carry at the saved position and rng-fold index — no
+        prefill, no new compiles, bitwise-identical to having kept the
+        slot resident. The saved chunk-overshoot buffer rides as the
+        slot's ``prefix`` (served host-side before any device token
+        counts against this turn)."""
+        if request.sample != sess.sample:
+            raise ValueError(
+                "continuation SampleConfig differs from the session's: the "
+                "resumed rng walk is only bitwise with the sampling "
+                "parameters it was suspended under"
+            )
+        prefix = np.asarray(sess.emitted[:, sess.served:])
+        target_new = request.max_new_tokens - prefix.shape[1]
+        if target_new <= 0:
+            raise ValueError(
+                "continuation fully covered by the session's buffered "
+                "tokens; the caller should serve it without a slot"
+            )
+        cap = self.model.cfg.max_seq_len
+        if int(sess.t) + target_new > cap:
+            raise ValueError(
+                f"session at position {int(sess.t)} + new {target_new} "
+                f"exceeds max_seq_len {cap}"
+            )
+        i = self._claim_slot(request.sample)
+        rng = jax.random.PRNGKey(sess.seed)
+        sub = (sess.token, sess.state, sess.t, sess.done)
+        self._insert(i, sub, rng, n_emitted=int(sess.emit))
+        self._slots[i] = _Slot(
+            request=request,
+            tag=tag,
+            deadline_at=deadline_at,
+            prompt=jnp.asarray(sess.prompt, jnp.int32),
+            toks=[],
+            session_id=sess.session_id,
+            seed=int(sess.seed),
+            prior=[np.asarray(sess.emitted)] if sess.emitted.size else [],
+            prefix=prefix if prefix.size else None,
+            target_new=target_new,
+            fold_base=int(sess.emit),
+            served_base=int(sess.served),
         )
         return i
 
@@ -273,7 +382,7 @@ class SlotEngine:
         now = self._clock()
         for i, slot in enumerate(self._slots):
             if slot is not None and slot.deadline_at is not None and now >= slot.deadline_at:
-                finished.append((slot.tag, self._evict(i, "deadline")))
+                finished.append((slot.tag, self._finish(i, "deadline")))
         if not self.busy:
             self._chunk_counter += 1
             return finished
@@ -285,7 +394,7 @@ class SlotEngine:
         if bad:
             carry, toks, bad = self._ladder(snap, active_dev, active, carry, toks, bad)
             for i in sorted(bad):  # ladder exhausted: fail those requests
-                finished.append((self._slots[i].tag, self._evict(i, "failed")))
+                finished.append((self._slots[i].tag, self._finish(i, "failed")))
                 active[i] = False
         self._carry = carry
         done_np = self._done_np
@@ -295,8 +404,8 @@ class SlotEngine:
             slot.toks.append((toks, i))
             slot.n_emitted += self.chunk
             slot.chunks += 1
-            if slot.n_emitted >= slot.request.max_new_tokens or done_np[i]:
-                finished.append((slot.tag, self._evict(i, "ok")))
+            if slot.n_emitted >= slot.target_new or done_np[i]:
+                finished.append((slot.tag, self._finish(i, "ok")))
         self._chunk_counter += 1
         return finished
 
@@ -386,17 +495,24 @@ class SlotEngine:
         """Ladder rung 2 for slot ``i``: solo re-prefill of prompt + the
         tokens emitted so far (the shared :func:`generate.reprefill_carry`
         — identical rng/done alignment to the solo session's rung),
-        row-written over the slot's poisoned snapshot state."""
+        row-written over the slot's poisoned snapshot state. For a
+        resumed session the history spans turns: ``prior`` (earlier
+        turns' emissions) precedes this turn's chunks, and the fold index
+        is anchored at ``fold_base`` so the rebuilt rng walk matches the
+        carry the snapshot held."""
         slot = self._slots[i]
-        emitted = [arr[row : row + 1] for arr, row in slot.toks]
-        rng = jax.random.PRNGKey(slot.request.seed)
+        emitted = list(slot.prior) + [
+            arr[row : row + 1] for arr, row in slot.toks
+        ]
+        rng = jax.random.PRNGKey(slot.seed)
+        fold = slot.fold_base + slot.n_emitted
         sub = reprefill_carry(
             self.model, self.params, slot.prompt, emitted, self._sample,
-            rng, buckets=self.buckets,
+            rng, buckets=self.buckets, sample_index=fold,
         )
         new_snap, self._rngs = _insert_carry(
             snap, self._rngs, sub, rng,
-            jnp.int32(i), jnp.int32(slot.n_emitted),
+            jnp.int32(i), jnp.int32(fold),
         )
         return new_snap
 
@@ -405,19 +521,19 @@ class SlotEngine:
     def _evict(self, i: int, status: str) -> DecodeResult:
         """Free slot ``i`` and materialize its request's result — the one
         sync per REQUEST lifetime (not per chunk), outside the scheduler's
-        per-chunk probe budget. Emitted tokens are trimmed to
-        max_new_tokens (the engine always runs whole chunks) and an
-        early-EOS eviction PAD-fills the tail, exactly what the solo scan
-        would have emitted."""
+        per-chunk probe budget. A resumed session's host-side buffer
+        (``prefix``) precedes this turn's device chunks; the total is
+        trimmed to max_new_tokens (the engine always runs whole chunks)
+        and an early-EOS eviction PAD-fills the tail, exactly what the
+        solo scan would have emitted."""
         slot = self._slots[i]
         self._slots[i] = None
         req = slot.request
         want = req.max_new_tokens
-        if slot.toks:
-            tokens = np.concatenate(
-                [np.asarray(arr)[row : row + 1] for arr, row in slot.toks],
-                axis=1,
-            )[:, :want]
+        parts = [] if slot.prefix is None else [slot.prefix]
+        parts += [np.asarray(arr)[row : row + 1] for arr, row in slot.toks]
+        if parts:
+            tokens = np.concatenate(parts, axis=1)[:, :want]
         else:
             tokens = np.zeros((1, 0), np.int32)
         n = tokens.shape[1]
@@ -433,6 +549,66 @@ class SlotEngine:
             rewinds=slot.rewinds,
             reprefills=slot.reprefills,
         )
+
+    def _finish(self, i: int, status: str) -> DecodeResult:
+        """Evict slot ``i`` — via suspension (state extracted and attached
+        to the result as a :class:`SessionState`) when the slot carries a
+        session id and its state is trustworthy. ``failed`` never
+        suspends: a ladder-exhausted slot's state is exactly what a
+        continuation must NOT resume from (the previous generation on
+        disk stays the session's truth)."""
+        slot = self._slots[i]
+        if slot.session_id is None or status == "failed":
+            return self._evict(i, status)
+        return self._suspend(i, status)
+
+    def _suspend(self, i: int, status: str) -> DecodeResult:
+        """Suspend slot ``i``: extract its carry row (one fused jitted
+        row-read, ``_extract_carry``), pull the O(1) state to host, and
+        free the slot. The SessionState rides out on the DecodeResult so
+        the server can persist it BEFORE releasing the result — a client
+        must never see tokens a crash could unremember."""
+        slot = self._slots[i]
+        token, state, t, emit, done = jax.device_get(
+            _extract_carry(self._carry, jnp.int32(i))
+        )
+        prior = [np.asarray(a) for a in slot.prior]
+        rows = [np.asarray(arr)[row : row + 1] for arr, row in slot.toks]
+        emitted = (
+            np.concatenate(prior + rows, axis=1)
+            if prior or rows
+            else np.zeros((1, 0), np.int32)
+        )
+        prompt = np.asarray(slot.prompt)
+        served_base = slot.served_base
+        result = self._evict(i, status)
+        result.session = SessionState(
+            session_id=slot.session_id,
+            seed=slot.seed,
+            sample=self._sample,
+            served=min(served_base + result.new_tokens, emitted.shape[1]),
+            token=np.asarray(token),
+            state=state,
+            t=np.asarray(t),
+            emit=np.asarray(emit),
+            done=np.asarray(done),
+            prompt=prompt,
+            emitted=emitted,
+        )
+        return result
+
+    def suspend_sessions(self) -> List[Tuple[Any, DecodeResult]]:
+        """Suspend EVERY resident session-tagged slot mid-stream with
+        status ``"suspended"`` (partial tokens + the session attached) —
+        the SIGTERM drain path: conversations survive the restart as one
+        O(1) snapshot each instead of holding the drain hostage for their
+        remaining tokens. Sessionless slots are untouched (they drain to
+        completion, the PR 4/5 contract)."""
+        out = []
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.session_id is not None:
+                out.append((slot.tag, self._finish(i, "suspended")))
+        return out
 
     def drain_evict_all(self, status: str = "failed") -> List[Tuple[Any, DecodeResult]]:
         """Forcibly evict every resident request with partial tokens (the

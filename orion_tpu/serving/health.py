@@ -59,16 +59,27 @@ class HealthMachine:
     history (the post-mortem artifact: *when* did we degrade, *what*
     said so)."""
 
+    # a flapping SERVING <-> DEGRADED replica transitions on every ladder
+    # engagement; unbounded history would grow the /healthz payload (and
+    # host memory) for the lifetime of the process. The last N transitions
+    # are the post-mortem-relevant ones; `dropped` says how many scrolled
+    # off so a reader knows the log is a suffix.
+    HISTORY_LIMIT = 64
+
     def __init__(
         self,
         clock: Callable[[], float] = time.monotonic,
         on_transition: Optional[Callable[[Health, Health, str], None]] = None,
+        history_limit: int = HISTORY_LIMIT,
     ):
+        assert history_limit >= 1, history_limit
         self._clock = clock
         self._on_transition = on_transition
         self._lock = threading.Lock()
         self._state = Health.STARTING
         self._since = clock()
+        self._history_limit = int(history_limit)
+        self.dropped = 0  # transitions aged out of the bounded history
         self.history: List[Tuple[Optional[Health], Health, str, float]] = [
             (None, Health.STARTING, "init", self._since)
         ]
@@ -100,18 +111,25 @@ class HealthMachine:
             self._state = new
             self._since = self._clock()
             self.history.append((old, new, reason, self._since))
+            if len(self.history) > self._history_limit:
+                drop = len(self.history) - self._history_limit
+                del self.history[:drop]
+                self.dropped += drop
         if self._on_transition is not None:
             self._on_transition(old, new, reason)
         return True
 
     def snapshot(self) -> dict:
         """The /healthz payload: current state, how long we've been in
-        it, and the full transition history."""
+        it, and the last ``history_limit`` transitions (``dropped``
+        counts the ones that aged out — the payload stays bounded on a
+        flapping long-lived replica)."""
         with self._lock:
             return {
                 "state": self._state.value,
                 "accepting": self.accepting,
                 "in_state_secs": self._clock() - self._since,
+                "dropped": self.dropped,
                 "transitions": [
                     {
                         "from": a.value if a else None,

@@ -34,6 +34,15 @@ deadlines, and watchdog beats all happen at chunk boundaries.
   exhausts the per-slot degradation ladder becomes an error/failed
   RESULT on its Pending; co-resident slots keep streaming and the
   process never dies for one request.
+- **durable sessions** — with ``session_dir`` set, a request carrying a
+  ``session_id`` becomes a conversation turn: its decode state is
+  suspended at turn end as one O(1) snapshot (write-through to the
+  integrity-manifested :class:`~orion_tpu.serving.session_store.SessionStore`,
+  LRU-capped host cache in front, idle eviction at chunk boundaries),
+  and a later turn resumes it — bitwise-identical to having kept the
+  slot resident, across server restarts. SIGTERM drain SUSPENDS
+  resident sessions instead of decoding their remaining tokens; a
+  corrupt on-disk session fails only its own request.
 """
 
 from __future__ import annotations
@@ -44,6 +53,8 @@ import queue
 import sys
 import threading
 import time
+import warnings
+from collections import OrderedDict
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -54,6 +65,7 @@ from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
 from orion_tpu.resilience.watchdog import Watchdog
 from orion_tpu.serving.health import Health, HealthMachine
 from orion_tpu.serving.session import DecodeRequest, DecodeResult
+from orion_tpu.serving.session_store import SessionState, SessionStore
 
 
 class OverloadError(RuntimeError):
@@ -74,6 +86,11 @@ class ServeConfig:
     grace: float = 30.0  # SIGTERM drain budget, as in training
     poll: float = 0.05  # idle queue poll cadence (seconds)
     prefill_buckets: str = "pow2"  # pad-to-bucket prompt lengths ("" = off)
+    # -- durable sessions (session_store.py); None = sessions disabled --
+    session_dir: Optional[str] = None  # on-disk session store root
+    session_idle_s: float = 300.0  # resident-cache idle eviction (0 = off)
+    max_resident_sessions: int = 64  # LRU cap on the host-resident cache
+    session_keep: int = 2  # retained generations per session on disk
 
 
 @dataclasses.dataclass
@@ -147,6 +164,27 @@ class Server:
             ),
         )
         self.health = HealthMachine(clock=clock)
+        # durable sessions: write-through disk store + a host-resident LRU
+        # cache in front of it (resident entries are ALWAYS also on disk,
+        # so idle/LRU eviction is pure cache management, and the race
+        # "idle eviction at the same boundary a continuation re-admits"
+        # degrades to a disk read, never a lost session)
+        self.session_store: Optional[SessionStore] = None
+        if cfg.session_dir:
+            self.session_store = SessionStore(
+                cfg.session_dir, keep=cfg.session_keep,
+                # a DRAINING/DEAD server must not burn its drain grace
+                # backing off on session I/O (resilience/retry.py)
+                should_abort=lambda: not self.health.accepting,
+            )
+        self._sessions: "OrderedDict[str, SessionState]" = OrderedDict()
+        self._session_last_use: Dict[str, float] = {}
+        self._active_sessions: set = set()  # ids resident in engine slots
+        # ids whose last save FAILED: their resident copy is the only
+        # up-to-date one, so cache eviction must not drop them (and the
+        # tick loop keeps retrying the save until disk catches up)
+        self._dirty_sessions: set = set()
+        self._dirty_retry_at: float = 0.0
         self._q: "queue.Queue[Pending]" = queue.Queue(maxsize=cfg.max_inflight)
         self._guard: Optional[PreemptionGuard] = None
         # submit() is documented thread-safe for feeder threads. The
@@ -162,6 +200,7 @@ class Server:
             "ok": 0, "deadline": 0, "failed": 0,
             "rewinds": 0, "reprefills": 0, "stalls": 0,
             "chunks": 0, "slot_steps_active": 0, "slot_steps_total": 0,
+            "suspended": 0, "resumed": 0, "session_saves": 0,
         }
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -239,6 +278,16 @@ class Server:
                 while True:
                     self._maybe_drain(guard)
                     draining = self.health.state is Health.DRAINING
+                    if draining:
+                        # durable sessions don't hold the drain hostage:
+                        # every resident session slot is SUSPENDED at this
+                        # boundary (one O(1) snapshot each, persisted
+                        # before the result is released) instead of
+                        # decoding its remaining tokens; sessionless
+                        # slots drain to completion as always
+                        for pending, result in self.engine.suspend_sessions():
+                            self._complete(pending, result)
+                    self._tick_sessions()
                     self._admit_from_queue(wd)
                     if not self.engine.busy:
                         if (draining or drain_when_idle) and self._q.empty():
@@ -323,15 +372,187 @@ class Server:
             ))
             return
         try:
-            self.engine.admit(pending.request, tag=pending, deadline_at=deadline_at)
+            if pending.request.session_id is not None:
+                self._admit_session(pending, deadline_at)
+            else:
+                self.engine.admit(
+                    pending.request, tag=pending, deadline_at=deadline_at
+                )
         except Exception as e:
             # request isolation: an unadmittable request is an error
-            # RESULT, never a dead process (and never a stuck batch)
+            # RESULT, never a dead process (and never a stuck batch) —
+            # this is also where a session whose every on-disk generation
+            # is corrupt fails ITS request only
             pending.error = e
             self._bump("failed")
             self._degrade(f"request refused: {type(e).__name__}: {e}")
             pending.done_at = self._clock()
             pending.done.set()
+
+    # -- durable sessions -----------------------------------------------------
+
+    def _admit_session(self, pending: Pending, deadline_at) -> None:
+        """Route a session-tagged request: resume a suspended session
+        (O(1) row insert; empty-prompt continuations are bitwise what one
+        longer uninterrupted request would have produced), rebase it when
+        the turn carries new prompt tokens (full-history re-prefill), or
+        start a fresh session. Raises into :meth:`_admit`'s isolation
+        handler on anything unadmittable."""
+        request = pending.request
+        sid = request.session_id
+        if self.session_store is None:
+            raise ValueError(
+                "request carries a session_id but sessions are disabled "
+                "(ServeConfig.session_dir is unset)"
+            )
+        if self.health.state is Health.DRAINING:
+            # queued session turns don't start work during a drain — they
+            # come back "suspended" untouched (nothing on disk changes;
+            # the client re-submits against the restarted server)
+            self._complete(pending, DecodeResult(
+                tokens=np.zeros((1, 0), np.int32), status="suspended",
+                new_tokens=0, chunks=0,
+            ))
+            return
+        if sid in self._active_sessions:
+            raise ValueError(
+                f"session {sid!r} is already resident in a slot; one turn "
+                "at a time per conversation"
+            )
+        sess = self._session_lookup(sid)
+        if sess is None:  # fresh conversation
+            self.engine.admit(
+                request, tag=pending, deadline_at=deadline_at, session_id=sid
+            )
+            self._active_sessions.add(sid)
+            return
+        prompt = np.asarray(request.prompt, np.int32).reshape(1, -1)
+        want = request.max_new_tokens
+        try:
+            if prompt.shape[1] > 0:
+                # new user tokens: rebase the context (original prompt +
+                # everything emitted + the new tokens) and re-prefill —
+                # O(history); the rng walk stays anchored at the carry's
+                # absolute fold index and the session's own seed
+                full = np.concatenate(
+                    [np.asarray(sess.prompt), np.asarray(sess.emitted), prompt],
+                    axis=1,
+                )
+                self.engine.admit(
+                    dataclasses.replace(request, prompt=full),
+                    tag=pending, deadline_at=deadline_at, session_id=sid,
+                    sample_index=int(sess.emit), seed=int(sess.seed),
+                )
+            elif sess.buffered >= want:
+                # the suspended carry's chunk overshoot already covers
+                # this turn: serve it host-side, no slot, no device work —
+                # the cheapest continuation there is
+                toks = np.asarray(
+                    sess.emitted[:, sess.served:sess.served + want]
+                )
+                sess.served += want
+                self._store_session(sess)
+                self._complete(pending, DecodeResult(
+                    tokens=toks, status="ok", new_tokens=want, chunks=0,
+                ))
+                return
+            else:
+                self.engine.resume(
+                    sess, request, tag=pending, deadline_at=deadline_at
+                )
+            self._active_sessions.add(sid)
+            self._bump("resumed")
+        except Exception:
+            # nothing was admitted: the session stays suspended exactly
+            # as loaded — put it back in the resident cache
+            self._cache_session(sess)
+            raise
+
+    def _session_lookup(self, sid: str) -> Optional[SessionState]:
+        """Resident cache first (popped while active), then the newest
+        intact on-disk generation (corrupt latest falls back inside the
+        store; all-corrupt raises — isolated to this request)."""
+        sess = self._sessions.pop(sid, None)
+        if sess is not None:
+            self._session_last_use.pop(sid, None)
+            return sess
+        if self.session_store is None:
+            return None
+        return self.session_store.load(sid)
+
+    def _cache_session(self, sess: SessionState) -> None:
+        self._sessions[sess.session_id] = sess
+        self._sessions.move_to_end(sess.session_id)
+        self._session_last_use[sess.session_id] = self._clock()
+        cap = max(self.cfg.max_resident_sessions, 1)
+        while len(self._sessions) > cap:
+            # LRU-evict the oldest CLEAN entry; a dirty one (save failed)
+            # is the only up-to-date copy of its conversation — dropping
+            # it would silently lose a turn the client already saw, so
+            # dirty sessions pin themselves resident until a save lands
+            victim = next(
+                (s for s in self._sessions if s not in self._dirty_sessions),
+                None,
+            )
+            if victim is None:
+                break  # everything dirty: hold memory over losing turns
+            self._sessions.pop(victim, None)
+            self._session_last_use.pop(victim, None)
+
+    def _store_session(self, sess: SessionState) -> None:
+        """Write-through persist + resident-cache refresh. A failed save
+        degrades health, marks the session DIRTY (pinned resident,
+        re-saved at tick boundaries), and keeps the resident copy so
+        in-process continuations still work — never raises into the
+        scheduler."""
+        self._active_sessions.discard(sess.session_id)
+        try:
+            if self.session_store is not None:
+                self.session_store.save(sess)
+                self._bump("session_saves")
+            self._dirty_sessions.discard(sess.session_id)
+        except Exception as e:
+            warnings.warn(
+                f"session {sess.session_id} save failed "
+                f"({type(e).__name__}: {e}); keeping the resident copy "
+                "dirty — a restart before the next successful save loses "
+                "this turn",
+                stacklevel=2,
+            )
+            self._dirty_sessions.add(sess.session_id)
+            self._degrade(f"session save failed: {type(e).__name__}")
+        self._cache_session(sess)
+
+    def _tick_sessions(self) -> None:
+        """Chunk-boundary cache maintenance: retry dirty sessions' saves
+        (throttled — a persistently failing store must not spend its
+        whole retry backoff budget at every chunk boundary), and drop
+        CLEAN resident entries idle past the timeout (those are already
+        on disk — eviction frees host memory, it never loses state;
+        dirty entries stay pinned until their save lands)."""
+        now = self._clock()
+        if (self.session_store is not None and self._dirty_sessions
+                and now >= self._dirty_retry_at):
+            self._dirty_retry_at = now + max(1.0, self.cfg.poll)
+            for sid in list(self._dirty_sessions):
+                sess = self._sessions.get(sid)
+                if sess is None or sid in self._active_sessions:
+                    continue
+                try:
+                    self.session_store.save(sess)
+                    self._bump("session_saves")
+                    self._dirty_sessions.discard(sid)
+                except Exception:
+                    continue  # still dirty, still pinned; retry later
+        idle = self.cfg.session_idle_s
+        if idle <= 0 or not self._sessions:
+            return
+        for sid in list(self._sessions):
+            if sid in self._dirty_sessions:
+                continue
+            if now - self._session_last_use.get(sid, now) > idle:
+                self._sessions.pop(sid, None)
+                self._session_last_use.pop(sid, None)
 
     def _step_chunk(self, wd, guard) -> None:
         """One engine boundary: watchdog beat, advance all slots a chunk,
@@ -349,6 +570,18 @@ class Server:
             self._complete(pending, result)
 
     def _complete(self, pending: Pending, result: DecodeResult) -> None:
+        if result.session is not None:
+            # durability before visibility: the session generation is on
+            # disk BEFORE the caller can observe these tokens (a crash
+            # right after must not unremember a turn the client saw)
+            self._store_session(result.session)
+        elif pending.request.session_id is not None:
+            # a session turn that finished WITHOUT a snapshot (ladder
+            # exhausted -> "failed", abnormal-exit eviction): release the
+            # conversation so the next turn can resume from the last
+            # good on-disk generation — a failed turn must never lock a
+            # session out until restart
+            self._active_sessions.discard(pending.request.session_id)
         pending.result = result
         self._bump(result.status)
         self._bump("rewinds", result.rewinds)
@@ -377,6 +610,10 @@ class Server:
             snap["stats"] = dict(self.stats)
         snap["occupancy"] = self.occupancy()
         snap["slots"] = self.engine.occupancy()
+        snap["sessions"] = {
+            "resident": len(self._sessions),
+            "in_slots": len(self._active_sessions),
+        }
         return snap
 
     def _maybe_drain(self, guard) -> None:

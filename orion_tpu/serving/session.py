@@ -69,23 +69,39 @@ class LadderExhausted(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class DecodeRequest:
     """One generation request. ``prompt``: token ids, [T] or [B, T].
-    ``deadline_ms`` <= 0 means no deadline."""
+    ``deadline_ms`` <= 0 means no deadline.
+
+    ``session_id`` makes the request a durable-session turn (server-side
+    sessions must be enabled): a fresh id starts a conversation whose
+    decode state is suspended at turn end (one O(1) snapshot,
+    serving/session_store.py); a known id continues it — with an empty
+    prompt the resume is an O(1) row insert (no prefill) and the
+    continuation is bitwise what one longer uninterrupted request would
+    have produced; with new prompt tokens the turn re-prefills the full
+    history (tokens are appended to the context before generation
+    continues). A continuation's ``sample`` must match the session's and
+    its ``seed`` is ignored in favor of the session's (both anchor the
+    resumed rng walk)."""
 
     prompt: Any
     max_new_tokens: int
     sample: SampleConfig = SampleConfig()
     seed: int = 0
     deadline_ms: float = 0.0
+    session_id: Optional[str] = None
 
 
 @dataclasses.dataclass
 class DecodeResult:
     tokens: np.ndarray  # [B, new_tokens]
-    status: str  # "ok" | "deadline" | "failed"
+    status: str  # "ok" | "deadline" | "failed" | "suspended"
     new_tokens: int
     chunks: int
     rewinds: int = 0
     reprefills: int = 0
+    # the suspended SessionState riding out of the engine for the server
+    # to persist before the result is released (durable sessions only)
+    session: Any = dataclasses.field(default=None, repr=False, compare=False)
 
     @property
     def degraded(self) -> bool:

@@ -47,6 +47,20 @@ class CheckpointIntegrityError(RuntimeError):
     manifest): structure, shape/dtype, or content checksum mismatch."""
 
 
+def atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
+    """Publish a JSON state file atomically: write a sibling ``.tmp``,
+    fsync-free ``os.replace`` into place. A reader (or a restart after a
+    kill mid-write) sees either the previous complete file or the new
+    complete file, never a torn one — the idiom the ``non-atomic-persist``
+    lint rule (analysis/rules/persist.py) enforces for every state file
+    under serving//resilience//training. Shared by checkpoint manifests
+    and the serving session store."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
 def _leaf_array(leaf: Any) -> np.ndarray:
     """Host view of a leaf's logical bytes; typed PRNG keys checksum their
     key data (old-style uint32 keys pass through np.asarray)."""
@@ -60,7 +74,13 @@ def _leaf_array(leaf: Any) -> np.ndarray:
 def build_manifest(state: Any, step: int) -> Dict[str, Any]:
     """Pytree structure + per-leaf shape/dtype/crc32 for ``state``. Pulls
     every leaf to host once — the same device->host traffic the async save
-    itself does, and the price of end-to-end content verification."""
+    itself does, and the price of end-to-end content verification.
+
+    ``state`` is any pytree, not just a TrainState: the serving session
+    store (serving/session_store.py) manifests bare session pytrees with
+    the same helper (``step`` doubles as its generation number), so a
+    suspended conversation gets exactly the integrity guarantees a
+    training checkpoint does."""
     leaves = []
     for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
         arr = _leaf_array(leaf)
@@ -239,10 +259,7 @@ class Checkpointer:
 
         def _write():
             os.makedirs(self._manifest_dir, exist_ok=True)
-            tmp = self._manifest_path(step) + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(manifest, f)
-            os.replace(tmp, self._manifest_path(step))  # atomic publish
+            atomic_write_json(self._manifest_path(step), manifest)
 
         call_with_retries(
             _write, self._retry, describe=f"checkpoint manifest (step {step})"
@@ -359,4 +376,5 @@ def abstract_like(state: Any) -> Any:
 __all__ = [
     "Checkpointer", "CheckpointIntegrityError", "abstract_like",
     "build_manifest", "verify_manifest", "read_manifest", "manifest_subtree",
+    "atomic_write_json",
 ]

@@ -65,12 +65,15 @@ def write_token_bin(path: str, tokens: np.ndarray, vocab_size: int) -> None:
     dtype = np.uint16 if vocab_size <= 65536 else np.uint32
     arr = np.asarray(tokens, dtype=dtype)
     arr.tofile(path)
-    with open(path + ".meta.json", "w") as f:
-        json.dump(
-            {"dtype": str(dtype.__name__ if hasattr(dtype, '__name__') else np.dtype(dtype).name),
-             "count": int(arr.size), "vocab_size": int(vocab_size)},
-            f,
-        )
+    # atomic publish (write-tmp-then-replace): a preempted writer must not
+    # leave a torn sidecar that silently mis-dtypes every later run
+    from orion_tpu.training.checkpoint import atomic_write_json
+
+    atomic_write_json(
+        path + ".meta.json",
+        {"dtype": str(dtype.__name__ if hasattr(dtype, '__name__') else np.dtype(dtype).name),
+         "count": int(arr.size), "vocab_size": int(vocab_size)},
+    )
 
 
 class TokenBinDataset:

@@ -332,7 +332,76 @@ def serve_loop(chunks):
     return np.asarray(outs)  # one sync AFTER the loop
 """,
     ),
+    (
+        "non-atomic-persist",
+        "orion_tpu/serving/dummy.py",
+        """
+import json
+
+def publish_state(path, payload):
+    with open(path, "w") as f:
+        json.dump(payload, f)
+""",
+        """
+import json
+import os
+
+def publish_state(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)  # atomic publish
+
+def read_state(path):
+    with open(path) as f:
+        return json.load(f)
+
+def append_log(path, line):
+    with open(path, "a") as f:  # append-only logs are prefix-valid
+        f.write(line)
+""",
+    ),
+    (
+        "non-atomic-persist",
+        "orion_tpu/resilience/dummy.py",
+        """
+def checkpoint_meta(path, blob):
+    f = open(path, mode="wb")
+    f.write(blob)
+    f.close()
+""",
+        """
+import os
+
+def checkpoint_meta(path, blob):
+    with open(path + ".tmp", mode="wb") as f:
+        f.write(blob)
+    os.rename(path + ".tmp", path)
+""",
+    ),
 ]
+
+
+def test_non_atomic_persist_scoped_to_persistence_subtrees():
+    """The same in-place write OUTSIDE serving//resilience//training (a
+    bench script, an exp harness) is not a finding — the rule encodes the
+    durability contract of the persistence layers, not a global style."""
+    src = """
+import json
+
+def dump(path, payload):
+    with open(path, "w") as f:
+        json.dump(payload, f)
+"""
+    assert "non-atomic-persist" in rule_ids(
+        lint_source(src, path="orion_tpu/training/dummy.py")
+    )
+    assert "non-atomic-persist" not in rule_ids(
+        lint_source(src, path="orion_tpu/analysis/dummy.py")
+    )
+    assert "non-atomic-persist" not in rule_ids(
+        lint_source(src, path="tests/test_dummy.py")
+    )
 
 
 @pytest.mark.parametrize(

@@ -130,6 +130,61 @@ def test_retry_nonretryable_and_exhaustion():
     assert len(calls2) == FAST_RETRY.attempts
 
 
+def test_retry_should_abort_cancels_remaining_budget():
+    """A DRAINING/DEAD server plumbs its health machine into
+    ``should_abort``: the first failure after the flag flips propagates
+    immediately — no backoff sleeps, no further attempts — so a drain
+    isn't held hostage by session/ckpt I/O retries. The first attempt
+    always runs; a True flag never suppresses a SUCCESS."""
+    calls, slept = [], []
+    draining = [False]
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 2:
+            draining[0] = True  # the SIGTERM lands mid-retry
+        raise OSError("blip")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(OSError, match="blip"):
+            call_with_retries(
+                flaky, FAST_RETRY, sleep=slept.append,
+                should_abort=lambda: draining[0],
+            )
+    assert len(calls) == 2, "abort after the failure that saw the flag"
+    assert len(slept) == 1, "no backoff sleep once aborting"
+    # a pre-set flag still allows the first attempt (and its success)
+    ok = call_with_retries(
+        lambda: "fine", FAST_RETRY, sleep=slept.append,
+        should_abort=lambda: True,
+    )
+    assert ok == "fine"
+
+
+def test_every_registered_chaos_site_is_exercised():
+    """Meta-test against dead chaos sites: every fault-injection site
+    registered in resilience/inject.py (plus every dynamic site-family
+    prefix) must appear literally in at least one chaos-marked test
+    module — a hook added without a test that drives it fails HERE, not
+    silently in production."""
+    test_dir = os.path.dirname(__file__)
+    corpus = {}
+    for name in sorted(os.listdir(test_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(test_dir, name)) as f:
+                text = f.read()
+            if "pytest.mark.chaos" in text:
+                corpus[name] = text
+    assert corpus, "no chaos-marked test modules found"
+    for site in list(inject.SITES) + list(inject.SITE_PREFIXES):
+        hits = [name for name, text in corpus.items() if site in text]
+        assert hits, (
+            f"fault site {site!r} is registered in resilience/inject.py but "
+            "no chaos test exercises it — cover it or retire the hook"
+        )
+
+
 def test_watchdog_manual_fake_clock():
     now = [0.0]
     wd = Watchdog(timeout=5.0, clock=lambda: now[0], monitor=False,

@@ -90,6 +90,26 @@ def test_health_machine_legal_path_and_illegal_edges():
         h.to(Health.SERVING, "dead is dead")
     snap = h.snapshot()
     assert snap["state"] == "dead" and len(snap["transitions"]) == 6
+    assert snap["dropped"] == 0
+
+
+def test_health_history_bounded_on_flapping_replica():
+    """A long-lived replica flapping SERVING <-> DEGRADED must not grow
+    its /healthz payload (or host memory) without bound: the history
+    keeps the last ``history_limit`` transitions and reports how many
+    scrolled off."""
+    h = HealthMachine(history_limit=8)
+    h.to(Health.SERVING, "ready")
+    for i in range(50):
+        h.to(Health.DEGRADED, f"flap {i}")
+        h.to(Health.SERVING, f"recover {i}")
+    assert len(h.history) == 8
+    snap = h.snapshot()
+    assert len(snap["transitions"]) == 8
+    assert snap["dropped"] == 102 - 8  # init + ready + 100 flaps
+    # the suffix is the NEWEST transitions, reasons intact
+    assert snap["transitions"][-1]["reason"] == "recover 49"
+    assert snap["state"] == "serving"
 
 
 # ---------------------------------------------------------------------------
