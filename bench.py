@@ -479,6 +479,19 @@ def bench_serve(
     except Exception as e:  # the slot rows are still a valid artifact
         print(json.dumps({"serve_sessions_error": repr(e)}), file=sys.stderr)
     _free_device_memory()
+    try:
+        out["adversarial"] = bench_serve_adversarial(reps=reps)
+        print(json.dumps({"serve_adversarial_ratios": {
+            "inscan_p99_over_baseline": out["adversarial"][
+                "inscan_p99_over_baseline"],
+            "host_p99_over_inscan": out["adversarial"][
+                "host_p99_over_inscan"],
+        }}), file=sys.stderr)
+    except Exception as e:
+        out["adversarial_error"] = repr(e)
+        print(json.dumps({"serve_adversarial_error": repr(e)}),
+              file=sys.stderr)
+    _free_device_memory()
     return out
 
 
@@ -556,6 +569,164 @@ def bench_session_admission(model, params, chunk: int = 4,
     }
     out["reprefill_over_resume"] = round(
         out["reprefill_admit_ms"] / max(out["resume_admit_ms"], 1e-9), 2
+    )
+    return out
+
+
+# -- adversarial trace: one long prompt among shorts (ISSUE 7) ----------------
+
+
+def _adversarial_pass(model, params, mode, arrivals, short_prompt,
+                      long_prompt, long_at, *, slots, chunk, pchunk,
+                      buckets, max_new, long_new):
+    """One pass of the adversarial trace through a fresh SlotEngine,
+    driven at the chunk-boundary level (no Server threads — the metric
+    is PER-TOKEN latency of co-resident short requests, so every
+    boundary's wall time is attributed to the tokens it emitted, and the
+    host-prefill stall lands inside the admission's iteration exactly as
+    a streaming client would feel it).
+
+    ``mode``: 'inscan' (staged prompts, in-scan consumption), 'host'
+    (legacy solo host-thread prefill at admission — the head-of-line
+    path, kept precisely for this comparison), 'baseline' (in-scan
+    engine, long prompt removed from the trace — the no-long-prompt
+    p99 the flat-tail acceptance is measured against).
+
+    GC is parked for the pass (a 2-4s window): at this operating point
+    p99 sits in the worst few boundaries, and a collector pause landing
+    on one boundary of one mode would decide the ratio instead of the
+    scheduler under test."""
+    import gc
+
+    import numpy as np
+
+    from orion_tpu.generate import SampleConfig
+    from orion_tpu.serving import DecodeRequest, SlotEngine
+
+    sample = SampleConfig(temperature=0.0)
+    eng = SlotEngine(
+        model, params, slots=slots, chunk=chunk, prefill_buckets=buckets,
+        prefill_chunk=(0 if mode == "host" else pchunk),
+    )
+    events = [(at, False) for at in arrivals]
+    if mode != "baseline":
+        events.append((long_at, True))
+    events.sort()
+    pending = list(events)
+    clock = time.monotonic
+    lat, results, seq = [], {}, 0
+    gc.collect()
+    gc.disable()
+    t0 = clock()
+    while pending or eng.busy:
+        it0 = clock()
+        while (pending and pending[0][0] <= it0 - t0
+               and eng.has_free_slot):
+            _, is_long = pending.pop(0)
+            eng.admit(DecodeRequest(
+                prompt=long_prompt if is_long else short_prompt,
+                max_new_tokens=long_new if is_long else max_new,
+                sample=sample, seed=seq,
+            ), tag="LONG" if is_long else seq)
+            seq += 1
+        if not eng.busy:
+            time.sleep(0.0005)
+            continue
+        # short slots already past their prompt emit this boundary; the
+        # boundary's whole wall time (admission included) is their tokens'
+        emitting_short = sum(
+            1 for s in eng._slots
+            if s is not None and s.prompt_remaining == 0
+            and s.tag != "LONG"
+        )
+        for tag, res in eng.step():
+            results[tag] = res
+        if emitting_short:
+            per_tok = (clock() - it0) / chunk * 1e3
+            lat.extend([per_tok] * emitting_short)  # weight: slots, not
+            # slots*chunk — equal values, percentiles are unchanged
+    gc.enable()
+    assert all(r.status == "ok" for r in results.values()), {
+        t: r.status for t, r in results.items() if r.status != "ok"
+    }
+    lat = np.sort(np.asarray(lat))
+    pct = lambda q: float(lat[min(len(lat) - 1, int(len(lat) * q))])  # noqa: E731
+    return {
+        "p50_token_ms": round(pct(0.50), 3),
+        "p99_token_ms": round(pct(0.99), 3),
+        "max_token_ms": round(float(lat[-1]), 3),
+        "short_completed": sum(1 for t in results if t != "LONG"),
+        "boundaries_observed": len(lat),
+    }
+
+
+def bench_serve_adversarial(slots: int = 8, chunk: int = 16,
+                            pchunk: int = 16, long_len: int = 4096,
+                            n_short: int = 64, rate_per_s: float = 110.0,
+                            max_new: int = 64, reps: int = 3) -> dict:
+    """The head-of-line acceptance row: one ``long_len``-token prompt
+    arriving mid-stream among short requests. Reports co-resident
+    per-token p50/p99 for three traces — no-long-prompt baseline,
+    in-scan prefill, and the legacy host-prefill path — and the two
+    ratios the ISSUE 7 acceptance pins: in-scan p99 / baseline p99
+    (flat, <= 1.15x) and host p99 / in-scan p99 (>= 2x).
+
+    Operating point: linear-attention chunk = prompt budget (``pchunk``
+    16), so one boundary's piece is a single 16-token batch-1 forward —
+    a few percent of the slots x chunk decode work it rides on (decode
+    chunk 16 amortizes the boundary against 16 tokens per resident slot).
+    The long prompt then takes ~256 boundaries to soak in, which is the
+    POINT: its cost is spread so thin the co-resident tail can't see it,
+    while the host path concentrates the same work into one ~100x
+    boundary. All-linear tiny config — O(1) state is the property under
+    test (a softmax-KV layer's piece cost scales with cache capacity,
+    not prompt budget)."""
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+
+    cfg = get_config("tiny", max_seq_len=long_len + max_new + chunk + 8,
+                     chunk=pchunk)
+    model = TransformerLM(cfg)
+    params = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)
+    )
+    params = jax.tree.map(lambda s: jnp.full(s.shape, 0.01, s.dtype), params)
+    arrivals = _serve_trace(n_short, rate_per_s, seed=7)
+    long_at = arrivals[len(arrivals) // 4]  # mid-stream, 1/4 in
+    short_prompt = jnp.ones((1, 8), jnp.int32)
+    long_prompt = jnp.ones((1, long_len), jnp.int32)
+    kw = dict(slots=slots, chunk=chunk, pchunk=pchunk,
+              buckets=(8, long_len), max_new=max_new, long_new=chunk)
+    out = {
+        "slots": slots, "chunk": chunk, "prefill_chunk": pchunk,
+        "long_prompt_len": long_len, "short_prompt_len": 8,
+        "n_short": n_short, "arrival_rate_per_s": rate_per_s,
+        "max_new_tokens": max_new, "reps_median_of": reps, "rows": {},
+    }
+    for mode in ("baseline", "inscan", "host"):
+        _adversarial_pass(model, params, mode, arrivals, short_prompt,
+                          long_prompt, long_at, **kw)  # untimed warm pass
+        rows = [
+            _adversarial_pass(model, params, mode, arrivals, short_prompt,
+                              long_prompt, long_at, **kw)
+            for _ in range(reps)
+        ]
+        rows.sort(key=lambda r: r["p99_token_ms"])
+        med = rows[len(rows) // 2]
+        med["p99_token_ms_reps"] = [r["p99_token_ms"] for r in rows]
+        out["rows"][mode] = med
+        print(json.dumps({f"serve_adversarial_{mode}": med}),
+              file=sys.stderr)
+    base = out["rows"]["baseline"]["p99_token_ms"]
+    out["inscan_p99_over_baseline"] = round(
+        out["rows"]["inscan"]["p99_token_ms"] / base, 3
+    )
+    out["host_p99_over_inscan"] = round(
+        out["rows"]["host"]["p99_token_ms"]
+        / out["rows"]["inscan"]["p99_token_ms"], 3
     )
     return out
 

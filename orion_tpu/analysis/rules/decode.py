@@ -23,6 +23,14 @@ how many slots are resident. Two extra shapes are findings —
   per-slot-probe shape: ``for slot in slots: self._probe(slot)`` inside
   the chunk loop syncs slot-count times per chunk).
 
+Since ISSUE 7 the ADMISSION path is covered too: in-scan chunked prefill
+makes ``admit()`` an O(1) slot insert (prompt staged into the carry, no
+prefill, no readback), so any host sync inside an admission-path
+function of ``serving/batching.py`` — one whose name contains ``admit``,
+``insert``, or ``stage`` — is a finding even OUTSIDE a loop: admissions
+sit on the scheduler's hot path and a per-admit device round-trip is the
+head-of-line stall the unified path exists to kill.
+
 Scope: the decode modules only (``orion_tpu/serving/`` and
 ``generate.py``); host loops elsewhere (eval CLIs, data prep) may sync
 freely. Traced code is already covered by ``tracer-host``; this rule is
@@ -48,6 +56,22 @@ def _is_decode_module(path: str) -> bool:
     return "serving/" in path or path.endswith("generate.py")
 
 
+_ADMIT_MARKERS = ("admit", "insert", "stage")
+
+
+def _inside_admission(node: ast.AST) -> bool:
+    """Lexically inside an admission-path function of the engine (see
+    module docstring: names containing admit/insert/stage)."""
+    cur = getattr(node, "_orion_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+            m in cur.name for m in _ADMIT_MARKERS
+        ):
+            return True
+        cur = getattr(cur, "_orion_parent", None)
+    return False
+
+
 def _inside_probe(node: ast.AST) -> bool:
     cur = getattr(node, "_orion_parent", None)
     while cur is not None:
@@ -68,6 +92,18 @@ def _is_probe_call(node: ast.Call) -> bool:
     if isinstance(f, ast.Name):
         return "probe" in f.id
     return False
+
+
+def _sync_label(node: ast.Call) -> Optional[str]:
+    """The one place that decides 'is this call a host sync, and how do
+    we print it' — shared by the loop and admission passes so the two
+    budgets can never disagree on what counts as a sync."""
+    name = dotted_name(node.func)
+    if name in _SYNC_NAMES or name in _SYNC_DOTTED:
+        return f"{name}()"
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_ATTRS:
+        return f".{node.func.attr}()"
+    return None
 
 
 def _innermost_loop(node: ast.AST) -> Optional[ast.AST]:
@@ -100,17 +136,7 @@ class DecodeHostSyncRule:
                 if _is_probe_call(node) and _innermost_loop(node) is loop:
                     if not _inside_probe(node):
                         probes_per_loop.setdefault(id(loop), (loop, []))[1].append(node)
-                name = dotted_name(node.func)
-                sync = None
-                if name in _SYNC_NAMES:
-                    sync = f"{name}()"
-                elif name in _SYNC_DOTTED:
-                    sync = f"{name}()"
-                elif (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _SYNC_ATTRS
-                ):
-                    sync = f".{node.func.attr}()"
+                sync = _sync_label(node)
                 if sync is None or _inside_probe(node):
                     continue
                 seen.add(id(node))
@@ -120,6 +146,27 @@ class DecodeHostSyncRule:
                     "trip every chunk; sync once after the loop, or move "
                     "it into the designated probe (a function named "
                     "*probe*, e.g. DecodeSession._probe_finite)",
+                )
+        # the admission budget: the engine's admit/insert/stage functions
+        # are sync-free — O(1) admission must not pay a device round-trip
+        # per request (loop or no loop)
+        if ctx.path.endswith("serving/batching.py"):
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                sync = _sync_label(node)
+                if sync is None or not _inside_admission(node):
+                    continue
+                if _inside_probe(node):
+                    continue
+                seen.add(id(node))
+                yield Finding(
+                    self.id, ctx.path, node.lineno,
+                    f"{sync} on the admission path (a function named "
+                    "*admit*/*insert*/*stage*): admission is an O(1) slot "
+                    "insert — stage the prompt into the carry and let the "
+                    "unified scan consume it; a per-admit host sync "
+                    "re-creates the head-of-line stall",
                 )
         # the probe budget: ONE probe sync per chunk loop, slot count
         # notwithstanding (the continuous-batching scheduler contract)

@@ -200,12 +200,65 @@ def _snap_decode_batched_tiny() -> Tuple[Any, Any, Dict[str, Any]]:
     return jaxpr, lowered, meta
 
 
+def _snap_decode_batched_prefill_tiny() -> Tuple[Any, Any, Dict[str, Any]]:
+    """The UNIFIED in-scan prefill + decode chunk (ISSUE 7,
+    generate.decode_batched_prefill_chunk) at slots=8, chunk=8,
+    prompt_bucket=16 — the program the engine runs while any slot is
+    mid-prefill. Pins three things: the scan-carry bytes stay LINEAR in
+    the slot count (the staged prompt buffer rides OUTSIDE the scan
+    carry — prefill must not fatten the O(1) decode state), collectives
+    stay zero, and — because the staging path is a separate jit — the
+    pure-decode program (``decode_batched_tiny``) keeps compiling
+    byte-identically when no slot is prefilling."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import (
+        SampleConfig,
+        _decode_batched_prefill_chunk_jit,
+    )
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM, init_decode_state
+
+    cfg = get_config("tiny")
+    model = TransformerLM(cfg)
+    slots, chunk, bucket, pchunk = 8, 8, 16, 128
+    key = jax.random.PRNGKey(0)
+    prompt = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    params = jax.eval_shape(model.init, key, prompt)
+    states = jax.eval_shape(partial(init_decode_state, cfg, slots))
+    vec = lambda dt: jax.ShapeDtypeStruct((slots,), dt)  # noqa: E731
+    carry = (
+        vec(jnp.int32), states, vec(jnp.int32), vec(jnp.int32),
+        vec(jnp.bool_),
+    )
+    rngs = jax.ShapeDtypeStruct((slots, 2), jnp.uint32)
+    active = vec(jnp.bool_)
+    pbuf = jax.ShapeDtypeStruct((slots, bucket), jnp.int32)
+    args = (
+        model, params, carry, rngs, active, pbuf, vec(jnp.int32),
+        vec(jnp.int32), chunk, pchunk, SampleConfig(),
+    )
+    jaxpr = jax.make_jaxpr(
+        _decode_batched_prefill_chunk_jit, static_argnums=(0, 8, 9, 10)
+    )(*args)
+    lowered = _decode_batched_prefill_chunk_jit.lower(*args)
+    meta = {
+        "slots": slots, "chunk": chunk, "prompt_bucket": bucket,
+        "prefill_chunk": pchunk, "donated_args": 0,
+    }
+    return jaxpr, lowered, meta
+
+
 # name -> () -> (closed_jaxpr, lowered, meta). Golden files live at
 # golden/<name>.json; adding a target here + --update-golden creates one.
 SNAPSHOT_TARGETS: Dict[str, Callable[[], Tuple[Any, Any, Dict[str, Any]]]] = {
     "train_tiny_dp8": _snap_train_tiny_dp8,
     "decode_tiny": _snap_decode_tiny,
     "decode_batched_tiny": _snap_decode_batched_tiny,
+    "decode_batched_prefill_tiny": _snap_decode_batched_prefill_tiny,
 }
 
 

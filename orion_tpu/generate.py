@@ -377,6 +377,190 @@ def decode_batched_chunk(
     )
 
 
+# -- in-scan chunked prefill (continuous batching, ISSUE 7) -------------------
+# Admission used to prefill each prompt SOLO on the host thread between
+# chunk boundaries — one long prompt stalled every resident slot
+# (head-of-line blocking; Orca/Sarathi-Serve territory). Because prefill
+# and decode share the same recurrent carry, a prefilling request can
+# instead OCCUPY a slot and consume its prompt inside the batched
+# program: each unified chunk first spends a ``prefill_chunk``-token
+# prompt budget on ONE selected slot as a parallel-forward PIECE
+# (transformer.prefill_extend_step — chunk-aligned pieces replay the
+# monolithic prefill's exact op sequence, so the carry is BITWISE what
+# host-side prefill_carry builds), then runs the decode scan with the
+# still-prefilling rows frozen (state/position/emit held, PAD emitted).
+# The budget is TOTAL, not per-slot (Sarathi's token-budget semantics):
+# a boundary's piece is one batch-1 forward however many slots are
+# mid-prefill, so the boundary tax co-resident decoders pay stays flat
+# in the slot count. Token-by-token prompt feeding inside the scan body
+# can NOT deliver the bitwise contract — a single-row matvec accumulates
+# differently from the prefill gemm — which is why the prompt is
+# consumed as parallel pieces at the top of the chunk rather than as
+# masked scan steps.
+
+
+def _where_rows(mask: Array, new: Any, old: Any) -> Any:
+    """Per-row select over a state pytree: row b takes ``new`` where
+    ``mask[b]``; frozen rows keep ``old`` BITWISE (select, not blend)."""
+    return jax.tree.map(
+        lambda n, o: jnp.where(
+            mask.reshape(mask.shape + (1,) * (n.ndim - 1)), n, o
+        ),
+        new, old,
+    )
+
+
+def _prefill_extend_row(
+    model: TransformerLM,
+    params: Any,
+    pbuf: Array,
+    states: Any,
+    sel: Array,
+    offset: Array,
+    length: Array,
+    pchunk: int,
+):
+    """Advance ONE slot's decode-state row by a prompt piece: row ``sel``
+    consumes ``length`` tokens of ``pbuf[sel]`` starting at ``offset``
+    as a batch-1 parallel forward (bitwise the solo
+    ``prefill_extend_step``'s op sequence; ``length`` 0 is a bitwise
+    no-op and the caller guards the write-back anyway). Batch-1 is the
+    point: the piece costs one slot's forward, not slots x one — a
+    vmapped all-rows piece was measured 2-4x a pure-decode boundary on
+    the tiny config, which is exactly the co-resident latency tax this
+    path exists to kill. Returns (last-real-row logits [V], the advanced
+    state row)."""
+    idx = jnp.clip(offset + jnp.arange(pchunk), 0, pbuf.shape[1] - 1)
+    piece = jnp.take(pbuf[sel], idx)[None]
+    st1 = jax.tree.map(lambda x: x[sel][None], states)
+    lg, st = model.apply(
+        params, piece, st1, offset, length, method="prefill_extend_step"
+    )
+    return lg[0], jax.tree.map(lambda x: x[0], st)
+
+
+def _decode_batched_prefill_body(
+    model, params, sample_cfg: SampleConfig, rngs, emitting, carry, _
+):
+    """The slot-multiplexed decode step with still-prefilling rows FROZEN:
+    ``emitting`` [S] is ``active & (t >= prompt_len)`` — rows past their
+    prompt decode exactly as in :func:`_decode_batched_body` (every op on
+    an emitting row computes the identical value, so the pure-decode walk
+    is reproduced bitwise), while mid-prefill rows hold their state,
+    position, emit index, and done flag, and emit PAD. The pure body
+    itself is untouched — its compiled program must stay byte-identical
+    (golden ``decode_batched_tiny``)."""
+    token, states, t, emit, done = carry
+    logits, new_states = model.apply(
+        params, token, states, t, method="decode_step"
+    )
+    keys = jax.vmap(jax.random.fold_in)(rngs, emit + 1)
+    nxt = _sample_rows(logits, keys, sample_cfg)
+    if sample_cfg.eos_token >= 0:
+        emitted = jnp.where(done, sample_cfg.pad_token, token)
+        # guard with ``emitting``: a mid-prefill row's token slot holds
+        # garbage that must not latch the done flag
+        done = done | (emitting & (emitted == sample_cfg.eos_token))
+    else:
+        emitted = token
+    emitted = jnp.where(emitting, emitted, sample_cfg.pad_token)
+    states = _where_rows(emitting, new_states, states)
+    token = jnp.where(emitting, nxt, token)
+    t = jnp.where(emitting, t + 1, t)
+    emit = jnp.where(emitting, emit + 1, emit)
+    return (token, states, t, emit, done), emitted
+
+
+@partial(jax.jit, static_argnums=(0, 8, 9, 10))
+def _decode_batched_prefill_chunk_jit(
+    model: TransformerLM,
+    params: Any,
+    carry: Any,
+    rngs: Array,
+    active: Array,
+    pbuf: Array,
+    plen: Array,
+    pfold: Array,
+    n_steps: int,
+    pchunk: int,
+    sample_cfg: SampleConfig,
+) -> Tuple[Any, Array]:
+    """One UNIFIED chunk: the prompt-budget piece, then the decode scan.
+
+    Stage 1 — the boundary's ``pchunk``-token prompt budget goes to ONE
+    slot with prompt left (``t < plen``): shortest remaining first, ties
+    to the lowest index — the slot closest to emitting frees its output
+    stream soonest, and the rule is deterministic from carry-resident
+    inputs so the host scheduler mirrors it without any readback
+    (``SlotEngine._selected_prefill_slot``). The piece is a batch-1
+    parallel forward (:func:`_prefill_extend_row`); a slot whose prompt
+    completes samples its first token from the piece's last-real-row
+    logits at rng-fold ``pfold`` (bitwise what host-side
+    ``prefill_carry`` samples). Stage 2 — the chunk's decode scan, with
+    rows still mid-prefill frozen. Everything per-slot rides traced, so
+    mixed prefill/decode traffic costs ONE compile per
+    (slots, chunk, prompt_bucket) — ``prompt_bucket`` being the staged
+    buffer's width. The effective piece never exceeds that width (a
+    single piece covers any prompt the buffer can hold, keeping piece
+    boundaries trivially chunk-aligned)."""
+    token, states, t, emit, done = carry
+    piece = min(pchunk, pbuf.shape[1])  # both static: piece <= the bucket
+    rem = jnp.maximum(plen - t, 0)
+    prefilling = active & (rem > 0)
+    has = prefilling.any()
+    sel = jnp.argmin(
+        jnp.where(prefilling, rem, jnp.iinfo(jnp.int32).max)
+    )
+    cons = jnp.where(has, jnp.minimum(rem[sel], piece), 0)
+    logits1, fed = _prefill_extend_row(
+        model, params, pbuf, states, sel, t[sel], cons, piece
+    )
+    # guarded row write-back: with no slot prefilling (rung-3 replays can
+    # mask the only one out) the garbage piece is discarded bitwise
+    states = jax.tree.map(
+        lambda x, n: x.at[sel].set(jnp.where(has, n, x[sel])), states, fed
+    )
+    completed = has & (rem[sel] <= piece)
+    key = jax.random.fold_in(rngs[sel], pfold[sel])
+    first = _sample_rows(logits1[None], key[None], sample_cfg)[0]
+    token = token.at[sel].set(jnp.where(completed, first, token[sel]))
+    emit = emit.at[sel].set(jnp.where(completed, pfold[sel], emit[sel]))
+    t = t.at[sel].set(t[sel] + cons)
+    emitting = active & (t >= plen)
+    body = partial(
+        _decode_batched_prefill_body, model, params, sample_cfg, rngs,
+        emitting,
+    )
+    carry, tokens = jax.lax.scan(
+        body, (token, states, t, emit, done), None, length=n_steps
+    )
+    return carry, jnp.moveaxis(tokens, 0, 1)  # [S, n_steps]
+
+
+def decode_batched_prefill_chunk(
+    model: TransformerLM,
+    params: Any,
+    carry: Any,
+    rngs: Array,
+    active: Array,
+    pbuf: Array,
+    plen: Array,
+    pfold: Array,
+    n_steps: int,
+    pchunk: int,
+    sample_cfg: SampleConfig,
+):
+    """Advance the slot-multiplexed carry by one unified prefill+decode
+    chunk (see :func:`_decode_batched_prefill_chunk_jit`). The engine
+    calls this only while at least one slot is mid-prefill; pure-decode
+    boundaries stay on :func:`decode_batched_chunk`, whose compiled
+    program this addition must not perturb."""
+    return _decode_batched_prefill_chunk_jit(
+        model, params, carry, rngs, active, pbuf, plen, pfold,
+        int(n_steps), int(pchunk), sample_cfg,
+    )
+
+
 def generate_chunked(
     model: TransformerLM,
     params: Any,

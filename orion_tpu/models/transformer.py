@@ -384,6 +384,135 @@ class Attention(nn.Module):
                 state = {"k": jnp.pad(kr, pad), "v": jnp.pad(v, pad)}
         return self._merge(out, single=False), state
 
+    # -- chunked prefill: advance decode state by one prompt piece -----------
+
+    def prefill_extend(
+        self, x: Array, state: State, offset: Array, length: Array
+    ) -> Tuple[Array, State]:
+        """One chunked-prefill piece: ``x`` [B, P, D] holds rows
+        [offset, offset+P) of the prompt's hidden stream (right-padded —
+        ``length`` of them real, both traced), ``state`` is the decode
+        state left by the pieces before it. Returns (attn out for the
+        piece rows, advanced state).
+
+        Bitwise contract (the serving engine's in-scan admission,
+        orion_tpu/serving/batching.py): when every piece boundary is a
+        multiple of the linear-attention chunk, piece-by-piece extension
+        reproduces the monolithic :meth:`prefill` EXACTLY on the xla
+        backend — real rows' outputs, (S, z), KV rows, and ring rows are
+        bitwise-identical, pinned by tests/test_prefill_inscan.py. The
+        ingredients:
+
+        - linear — the numerator state AND the z normalizer thread through
+          ``linear_attention(initial_state=...)``'s chunk-granular scan (a
+          strict left fold — splitting at chunk boundaries replays the
+          identical op sequence; ops/linear_attention.py return_zcum).
+          Pad rows' phi(k)/v are zeroed exactly like bucketed prefill.
+        - softmax — per-token projections and rotary are row-stable, so
+          the piece's KV rows are written into the cache (masked
+          read-modify-write) and the piece's queries attend over the
+          WHOLE cache under an offset causal mask; masked lanes are exact
+          zeros after softmax, so key-axis padding to the cache capacity
+          is reduction-neutral.
+        - swa — the piece attends over a [W + P] context assembled from
+          the ring (position-ordered gather) plus its own rows; the ring
+          is then rebuilt from the last W real positions, sourcing each
+          row from the piece or the previous ring.
+
+        Token-by-token consumption inside the decode scan can NOT deliver
+        this contract — a single-row matvec accumulates differently from
+        the prefill gemm (measured: kv rows differ at 1e-6 on CPU) — which
+        is why chunked prefill is pieces of the parallel forward between
+        scan chunks rather than a mask inside the scan body.
+        """
+        from orion_tpu.ops.softmax_attention import softmax_attention_xla
+
+        cfg = self.cfg
+        q, k, v = self._heads(x)
+        p = x.shape[-2]
+        real = (jnp.arange(p) < length)[None, None, :, None]
+        if self.layer_type == "linear":
+            qf, kf = self._phi_map(q), self._phi_map(k)
+            # where (not multiply): 0*nan from a degenerate feature map
+            # must not poison the masked state (same as bucketed prefill)
+            kf = jnp.where(real, kf, jnp.zeros_like(kf))
+            vm = jnp.where(real, v, jnp.zeros_like(v))
+            out, (s, z) = linear_attention(
+                qf, kf, vm, backend=cfg.backend, chunk=cfg.chunk,
+                initial_state=(state["s"], state["z"]), return_state=True,
+            )
+            new_state = {"s": s, "z": z}
+        else:
+            # clipped gather, not dynamic_slice: a garbage offset (the
+            # batched stage computes pieces for NON-prefilling rows too,
+            # then discards them) must not clamp-shift anything; real rows
+            # always sit at in-range positions
+            pos = jnp.clip(offset + jnp.arange(p), 0, self.freqs.shape[0] - 1)
+            ang = jnp.take(self.freqs, pos, axis=0)
+            qr = apply_rotary(q, ang)
+            kr = apply_rotary(k, ang)
+            if self.layer_type == "swa":
+                out, new_state = self._swa_extend(
+                    qr, kr, v, state, offset, length, cfg.window
+                )
+            else:
+                kc = _window_write(state["k"], kr, offset, real)
+                vc = _window_write(state["v"], v, offset, real)
+                row = jnp.arange(p)[:, None] + offset
+                col = jnp.arange(kc.shape[-2])[None, :]
+                out = softmax_attention_xla(
+                    qr, kc, vc, causal=False, mask=row >= col
+                )
+                new_state = {"k": kc, "v": vc}
+        return self._merge(out, single=False), new_state
+
+    def _swa_extend(
+        self, qr: Array, kr: Array, v: Array, state: State,
+        offset: Array, length: Array, window: int,
+    ) -> Tuple[Array, State]:
+        """Sliding-window piece attention + ring-buffer advance (see
+        :meth:`prefill_extend`). The context is the W positions before the
+        piece (gathered from the ring in position order) plus the piece's
+        own rows; negative/garbage positions are masked, never read."""
+        from orion_tpu.ops.softmax_attention import softmax_attention_xla
+
+        p = qr.shape[-2]
+        w = window
+        pos_prev = offset - w + jnp.arange(w)  # may be < 0 (masked below)
+        slots_prev = pos_prev % w
+        kprev = jnp.take(state["k"], slots_prev, axis=2)
+        vprev = jnp.take(state["v"], slots_prev, axis=2)
+        kctx = jnp.concatenate(
+            [kprev, kr.astype(state["k"].dtype)], axis=2
+        )
+        vctx = jnp.concatenate([vprev, v.astype(state["v"].dtype)], axis=2)
+        row = (jnp.arange(p)[:, None] + offset)
+        colpos = jnp.concatenate(
+            [pos_prev, offset + jnp.arange(p)]
+        )[None, :]
+        m = (row >= colpos) & (row - colpos < w) & (colpos >= 0)
+        out = softmax_attention_xla(qr, kctx, vctx, causal=False, mask=m)
+        # rebuild the ring as the last W positions before offset+length:
+        # rows from this piece where they cover, the previous ring where
+        # they don't; slots (pos % W) of W consecutive positions are a
+        # permutation, so the scatter is collision-free and deterministic
+        t_cur = offset + length
+        pos_new = t_cur - w + jnp.arange(w)
+        slots_new = pos_new % w
+        take = jnp.clip(pos_new - offset, 0, p - 1)
+        sel = (pos_new >= offset)[None, None, :, None]
+        kc = state["k"].at[:, :, slots_new, :].set(jnp.where(
+            sel,
+            jnp.take(kr.astype(state["k"].dtype), take, axis=2),
+            jnp.take(state["k"], slots_new, axis=2),
+        ))
+        vc = state["v"].at[:, :, slots_new, :].set(jnp.where(
+            sel,
+            jnp.take(v.astype(state["v"].dtype), take, axis=2),
+            jnp.take(state["v"], slots_new, axis=2),
+        ))
+        return out, {"k": kc, "v": vc}
+
     # -- one-token decode ---------------------------------------------------
 
     def decode_step(self, x: Array, state: State, t: Array) -> Tuple[Array, State]:
@@ -438,6 +567,25 @@ def _favor_proj_init(rng: Array, dh: int) -> Array:
     from orion_tpu.ops.feature_maps import _orthogonal_gaussian
 
     return _orthogonal_gaussian(rng, dh, dh)
+
+
+def _window_write(
+    cache: Array, rows: Array, offset: Array, real: Array
+) -> Array:
+    """Masked read-modify-write of a [B, H, P, Dh] row block into the full
+    KV cache at traced ``offset``: pad rows (``real`` False) keep whatever
+    the cache held, so a partial final piece never clobbers slots the
+    decode's ``slot <= t`` rule may later expose. Scatter at clipped
+    per-row positions, NOT dynamic_update_slice: an out-of-range offset
+    (pieces are computed for non-prefilling rows too, then discarded)
+    would make dynamic_update_slice clamp the window and silently shift
+    every row; here pad/garbage rows write the cache's own value back —
+    a bitwise no-op even when clipping collides their positions."""
+    p = rows.shape[-2]
+    pos = jnp.clip(offset + jnp.arange(p), 0, cache.shape[-2] - 1)
+    cur = jnp.take(cache, pos, axis=2)
+    new = jnp.where(real, rows.astype(cache.dtype), cur)
+    return cache.at[:, :, pos, :].set(new)
 
 
 def _swa_cache_from_prefill(kr: Array, v: Array, t: int, window: int) -> State:
@@ -550,6 +698,14 @@ class Block(nn.Module):
 
     def prefill(self, x, length=None):
         h, state = self.attn.prefill(self.norm1(x), length)
+        x = x + h
+        x = x + self.mlp(self.norm2(x))
+        return x, state
+
+    def prefill_extend(self, x, state, offset, length):
+        h, state = self.attn.prefill_extend(
+            self.norm1(x), state, offset, length
+        )
         x = x + h
         x = x + self.mlp(self.norm2(x))
         return x, state
@@ -758,6 +914,32 @@ class TransformerLM(nn.Module):
             x, st = blk.decode_step(x, st, t)
             new_states.append(st)
         return self._head(x), new_states
+
+    def prefill_extend_step(
+        self, tokens: Array, states: List[State], offset: Array, length: Array
+    ) -> Tuple[Array, List[State]]:
+        """One chunked-prefill PIECE at the model level: ``tokens`` [B, P]
+        are prompt rows [offset, offset + P) (right-padded — ``length`` of
+        them real, both traced), ``states`` the decode state left by the
+        pieces before. Returns (logits of the last REAL row [B, V], the
+        advanced states) — after the final piece, exactly what
+        ``prefill_last`` hands the first-token sampler, bitwise (the
+        serving engine's in-scan admission; see Attention.prefill_extend
+        for the per-layer-type contract). Positions are clipped, not
+        sliced: the batched stage runs this for non-prefilling slots too
+        and discards their rows, so garbage offsets must stay in-range
+        rather than clamp-shift."""
+        p = tokens.shape[-1]
+        pos = jnp.clip(offset + jnp.arange(p), 0, self.cfg.max_seq_len - 1)
+        x = self._embed(tokens, pos)
+        new_states = []
+        for blk, st in zip(self.blocks, states):
+            x, st = blk.prefill_extend(x, st, offset, length)
+            new_states.append(st)
+        last = jax.lax.dynamic_slice_in_dim(
+            x, jnp.maximum(length - 1, 0), 1, axis=1
+        )
+        return self._head(last)[:, 0], new_states
 
 
 def snapshot_decode_state(states: List[State]) -> List[State]:

@@ -73,7 +73,7 @@ def _pad_chunks(x: Array, chunk: int) -> Tuple[Array, int]:
     return x, t
 
 
-@partial(jax.jit, static_argnames=("chunk", "return_state"))
+@partial(jax.jit, static_argnames=("chunk", "return_state", "return_zcum"))
 def causal_dot_product_chunked(
     q: Array,
     k: Array,
@@ -81,6 +81,8 @@ def causal_dot_product_chunked(
     chunk: int = 128,
     return_state: bool = False,
     initial_state: Optional[Array] = None,
+    initial_z: Optional[Array] = None,
+    return_zcum: bool = False,
 ):
     """Chunked causal dot product via lax.scan over sequence chunks.
 
@@ -93,6 +95,20 @@ def causal_dot_product_chunked(
 
     If ``return_state``, also returns the final state S (for prefill →
     recurrent decode handoff). ``initial_state`` seeds S (default zeros).
+
+    ``return_zcum`` additionally threads the key normalizer z = Σ k_s
+    through the SAME scan carry and emits its per-position prefix rows:
+    returns ``(out, zcum, s_final, z_final)`` (``initial_z`` seeds z).
+    The point is ASSOCIATIVITY, not speed: a global ``jnp.cumsum`` lowers
+    to a parallel-prefix tree whose grouping depends on the total length,
+    so a prompt prefilled in pieces (serving's chunked prefill,
+    generate.prefill_extend_carry) could never reproduce the monolithic
+    normalizer bitwise. Per-chunk ``z + cumsum(k_chunk)`` with z carried
+    by the scan is a strict left fold over chunk totals — any split of
+    the sequence at chunk boundaries replays the identical op sequence,
+    which is what makes piecewise prefill == monolithic prefill an
+    identity instead of an allclose. The default path (no zcum) is left
+    byte-identical to keep the training program unchanged.
     """
     orig_dtype = q.dtype
     qf, kf, vf = _f32(q, k, v)
@@ -118,6 +134,35 @@ def causal_dot_product_chunked(
         s0 = vma_zeros_state(kf, vf)
     else:
         s0 = initial_state.astype(jnp.float32)
+
+    if return_zcum:
+        z0 = (
+            jnp.zeros_like(kf[..., 0, :])
+            if initial_z is None
+            else initial_z.astype(jnp.float32)
+        )
+
+        def body_z(carry, qkv):
+            s, z = carry
+            qi, ki, vi = qkv
+            scores = jnp.einsum("...td,...sd->...ts", qi, ki) * mask
+            intra = jnp.einsum("...ts,...sd->...td", scores, vi)
+            inter = jnp.einsum("...td,...de->...te", qi, s)
+            s_new = s + jnp.einsum("...td,...te->...de", ki, vi)
+            zc = z[..., None, :] + jnp.cumsum(ki, axis=-2)
+            return (s_new, zc[..., -1, :]), (intra + inter, zc)
+
+        (s_final, z_final), (out, zcum) = jax.lax.scan(
+            body_z, (s0, z0), (qc, kc, vc)
+        )
+        out = jnp.moveaxis(out, 0, -3).reshape(*batch_shape, n * chunk, dv)
+        zcum = jnp.moveaxis(zcum, 0, -3).reshape(*batch_shape, n * chunk, dk)
+        return (
+            out[..., :t, :].astype(orig_dtype),
+            zcum[..., :t, :],
+            s_final,
+            z_final,
+        )
 
     def body(s, qkv):
         qi, ki, vi = qkv
@@ -238,6 +283,23 @@ def linear_attention(
     s0 = z0 = None
     if initial_state is not None:
         s0, z0 = initial_state
+
+    if return_state and b == "xla":
+        # state-handoff path (prefill / chunked-prefill pieces): numerator
+        # AND normalizer ride the same chunk-granular scan, so splitting
+        # the sequence at chunk boundaries and threading (S, z) replays the
+        # identical op sequence — piecewise prefill is bitwise-equal to
+        # monolithic by construction (causal_dot_product_chunked docstring).
+        # Training forward (return_state=False) keeps the original program.
+        num, zcum, s_final, z_final = causal_dot_product_chunked(
+            q, k, v, chunk=chunk, initial_state=s0, initial_z=z0,
+            return_zcum=True,
+        )
+        den = jnp.einsum("...td,...td->...t", q.astype(jnp.float32), zcum)
+        out = (num.astype(jnp.float32) / (den[..., None] + eps)).astype(
+            q.dtype
+        )
+        return out, (s_final.astype(jnp.float32), z_final)
 
     if return_state:
         num, s_final = causal_dot_product(

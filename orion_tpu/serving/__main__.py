@@ -55,7 +55,19 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--prefill-buckets", default="pow2",
                    help="prompt-length buckets for prefill padding: 'pow2' "
                         "(default), a comma list like '32,64,128', or 'off' "
-                        "(one prefill compile per novel prompt length)")
+                        "(one prefill compile per novel prompt length; "
+                        "host-prefill only — requires --prefill-chunk 0)")
+    p.add_argument("--prefill-chunk", type=int, default=64,
+                   help="in-scan chunked prefill: prompt tokens consumed "
+                        "per chunk boundary INSIDE the batched scan, so a "
+                        "long prompt never stalls co-resident decoders "
+                        "(admission becomes an O(1) slot insert); 0 = "
+                        "legacy host-thread prefill at admission")
+    p.add_argument("--prompt-overflow", choices=["error", "clamp"],
+                   default="error",
+                   help="prompts longer than the largest prefill bucket: "
+                        "refuse the request cleanly (error, default) or "
+                        "serve the newest bucket-sized context (clamp)")
     p.add_argument("--deadline-ms", type=float, default=0.0,
                    help="per-request deadline, enforced at chunk "
                         "boundaries (0 = none)")
@@ -170,6 +182,8 @@ def _run(args, guard) -> int:
             max_inflight=args.max_inflight,
             deadline_ms=args.deadline_ms, stall_timeout=args.stall_timeout,
             grace=args.grace, prefill_buckets=args.prefill_buckets,
+            prefill_chunk=args.prefill_chunk,
+            prompt_overflow=args.prompt_overflow,
             session_dir=args.session_dir, session_idle_s=args.session_idle_s,
         ),
     )
@@ -233,8 +247,11 @@ def _run(args, guard) -> int:
         tag = "" if r.status == "ok" else f" [{r.status}]"
         print(line + tok.decode(ids) + tag)
     print(f"stats: {server.stats}", file=sys.stderr)
+    mode = (f"in-scan prefill, {server.engine.prefill_chunk} tok/boundary"
+            if args.prefill_chunk else "host prefill")
     print(f"slot occupancy: {server.occupancy():.3f} "
-          f"({args.slots} slot(s), chunk {args.chunk})", file=sys.stderr)
+          f"({args.slots} slot(s), chunk {args.chunk}, {mode})",
+          file=sys.stderr)
     return rc
 
 

@@ -14,11 +14,22 @@ row evictions on one carry.
   whole serving lifetime costs one decode compile per (slots, chunk)
   regardless of arrival order; per-slot positions (vector ``t``), per-slot
   rng streams, and the active mask all ride in traced.
-- **admission** — at chunk boundaries only: a new request is prefilled
-  individually (``generate.prefill_carry``, optionally bucket-padded), then
-  its state / first token / position are row-written into a free slot
-  (``transformer.insert_decode_slot``). Mid-stream admission at a nonzero
-  position is the normal case, not an edge case.
+- **admission** — at chunk boundaries only, and since ISSUE 7 an O(1)
+  row insert: the prompt is STAGED into the carry (padded to its bucket)
+  and consumed INSIDE the batched scan
+  (``generate.decode_batched_prefill_chunk``) — each boundary spends a
+  ``prefill_chunk``-token prompt budget on ONE slot (shortest remaining
+  first; the budget is total, not per-slot, so the boundary tax stays
+  flat in the slot count) as a chunk-aligned parallel-forward piece that
+  replays the monolithic prefill's exact op sequence, so the carry a
+  staged slot reaches is BITWISE what host-side prefill built, while
+  co-resident decoders never wait behind a long prompt (the
+  Sarathi-style head-of-line fix, without a scheduler: O(1) state makes
+  chunked prefill a mask). ``prefill_chunk=0`` keeps the legacy path —
+  prefill each prompt solo on the host thread
+  (``generate.prefill_carry``) and row-write the ready carry
+  (``transformer.insert_decode_slot``). Mid-stream admission at a
+  nonzero position is the normal case, not an edge case.
 - **eviction** — a slot is freed at the boundary where its request
   finishes: per-slot EOS (every later token is PAD by construction, so the
   tail is filled host-side, bitwise what the solo scan emits), max-tokens,
@@ -53,7 +64,9 @@ import numpy as np
 
 from orion_tpu.generate import (
     SampleConfig,
+    bucket_for,
     decode_batched_chunk,
+    decode_batched_prefill_chunk,
     prefill_carry,
     reprefill_carry,
 )
@@ -79,12 +92,15 @@ def _slot_flags(states, done) -> Array:
 
 
 @jax.jit
-def _insert_carry(carry, rngs, sub_carry, rng, i, n_emitted):
+def _insert_carry(carry, rngs, plen, pfold, sub_carry, rng, i, n_emitted):
     """Row-write one solo prefill carry (batch 1) + its rng key into slot
     ``i`` of the batched carry — ONE fused dispatch for the whole
     admission (a dozen eager ``.at`` updates would cost more host time
     than the prefill itself; admissions sit on the scheduler's hot path).
-    ``i`` and ``n_emitted`` ride traced: one compile, ever."""
+    ``i`` and ``n_emitted`` ride traced: one compile, ever. The slot's
+    staged-prompt length is zeroed — a row inserted with a READY carry is
+    past its prompt by definition, so the unified in-scan program must
+    never treat it as prefilling."""
     token, states, t, emit, done = carry
     tok1, st1, t1, done1 = sub_carry
     new_carry = (
@@ -94,7 +110,52 @@ def _insert_carry(carry, rngs, sub_carry, rng, i, n_emitted):
         emit.at[i].set(n_emitted.astype(jnp.int32)),
         done.at[i].set(done1[0]),
     )
-    return new_carry, rngs.at[i].set(rng)
+    return (
+        new_carry, rngs.at[i].set(rng), plen.at[i].set(0),
+        pfold.at[i].set(n_emitted.astype(jnp.int32)),
+    )
+
+
+@jax.jit
+def _stage_prompt_carry(carry, rngs, plen, pfold, pbuf, row, rng, i,
+                        length, fold):
+    """O(1) in-scan admission: zero slot ``i``'s carry row and park its
+    padded prompt in the staging buffer — NO prefill runs here and no
+    host sync happens; the unified chunk program consumes the prompt
+    ``prefill_chunk`` tokens per boundary from inside the batched scan.
+    One fused dispatch per admit, one compile per staged-buffer width."""
+    token, states, t, emit, done = carry
+    states = jax.tree.map(
+        lambda x: x.at[i].set(jnp.zeros(x.shape[1:], x.dtype)), states
+    )
+    new_carry = (
+        token.at[i].set(0),
+        states,
+        t.at[i].set(0),
+        emit.at[i].set(fold),
+        done.at[i].set(False),
+    )
+    return (
+        new_carry, rngs.at[i].set(rng), plen.at[i].set(length),
+        pfold.at[i].set(fold), pbuf.at[i].set(row),
+    )
+
+
+@jax.jit
+def _restart_prefill_row(carry, i):
+    """Ladder rung 2 for a slot still MID-prefill: zero its state row and
+    rewind its position to 0 so the in-scan prefill replays from scratch
+    (deterministic — the final tokens are bitwise what the unfaulted run
+    emits, just a few boundaries later). The staged prompt buffer is the
+    one known-good input and is left untouched."""
+    token, states, t, emit, done = carry
+    states = jax.tree.map(
+        lambda x: x.at[i].set(jnp.zeros(x.shape[1:], x.dtype)), states
+    )
+    return (
+        token.at[i].set(0), states, t.at[i].set(0), emit,
+        done.at[i].set(False),
+    )
 
 
 @jax.jit
@@ -149,6 +210,11 @@ class _Slot:
     toks: List[Tuple[Array, int]]
     n_emitted: int = 0
     chunks: int = 0  # request-local chunk index (fault-hook address)
+    # prompt tokens the in-scan prefill has yet to consume (0 = decoding;
+    # host-prefill admissions are always 0). The host mirror of the
+    # device-side ``plen - t`` — deterministic, so no readback is needed
+    # to know when a slot starts emitting.
+    prompt_remaining: int = 0
     rewinds: int = 0
     reprefills: int = 0
     # -- durable-session bookkeeping (all inert for sessionless requests) --
@@ -184,20 +250,50 @@ class SlotEngine:
         chunk: int = 16,
         clock: Callable[[], float] = time.monotonic,
         prefill_buckets: Tuple[int, ...] = (),
+        prefill_chunk: int = 0,
+        prompt_overflow: str = "error",
     ):
         assert slots > 0, slots
         assert chunk > 0, chunk
+        assert prompt_overflow in ("error", "clamp"), prompt_overflow
         self.model = model
         self.params = params
         self.slots = int(slots)
         self.chunk = int(chunk)
         self._clock = clock
         self.buckets = tuple(prefill_buckets)
+        self.prompt_overflow = prompt_overflow
+        cfg = model.cfg
+        # in-scan chunked prefill (prefill_chunk > 0): admission stages
+        # the prompt into the carry and the unified chunk program spends
+        # a prefill_chunk-token budget per boundary on one prefilling
+        # slot — no host-side prefill call, no head-of-line stall. 0 =
+        # the legacy host-prefill admission (the bench's comparison path).
+        self.prefill_chunk = 0
+        if prefill_chunk:
+            from orion_tpu.ops.dispatch import resolve, resolve_chunk
+
+            if not self.buckets:
+                # staged buffers need a bounded width set — refusing is
+                # better than silently overriding an explicit
+                # prefill_buckets="off" (whose one-compile-per-length
+                # semantics in-scan staging cannot deliver)
+                raise ValueError(
+                    "in-scan prefill (prefill_chunk > 0) needs prompt "
+                    "buckets to bound the staged-buffer widths; set "
+                    "prefill_buckets (e.g. 'pow2') or prefill_chunk=0 "
+                    "for host-side prefill"
+                )
+            # piece boundaries must land on linear-attention chunk
+            # boundaries (the left-fold bitwise contract — see
+            # ops/linear_attention.py return_zcum): round the knob up
+            c = resolve_chunk(cfg.chunk, cfg.max_seq_len,
+                              resolve(cfg.backend))
+            self.prefill_chunk = -(-int(prefill_chunk) // c) * c
         self._sample: Optional[SampleConfig] = None  # set by first admit
         self._slots: List[Optional[_Slot]] = [None] * self.slots
         self._chunk_counter = 0  # global boundary index (serve.chunk hook)
         # device carry: (token [S], states, t [S], emit [S], done [S])
-        cfg = model.cfg
         self._carry = (
             jnp.zeros((self.slots,), jnp.int32),
             init_decode_state(cfg, self.slots),
@@ -208,6 +304,13 @@ class SlotEngine:
         self._rngs = jnp.tile(
             jax.random.PRNGKey(0)[None], (self.slots, 1)
         )
+        # in-scan prefill staging: per-slot real prompt length, first-
+        # token rng-fold index, and the padded prompt buffer (allocated
+        # lazily at the first staged admission; width = the largest
+        # bucket seen, the unified program's prompt_bucket compile key)
+        self._plen = jnp.zeros((self.slots,), jnp.int32)
+        self._pfold = jnp.zeros((self.slots,), jnp.int32)
+        self._pbuf: Optional[Array] = None
         self._done_np = np.ones((self.slots,), bool)
 
     # -- occupancy ------------------------------------------------------------
@@ -224,12 +327,23 @@ class SlotEngine:
     def has_free_slot(self) -> bool:
         return self.active_count < self.slots
 
+    @property
+    def prefilling_count(self) -> int:
+        """Slots whose staged prompt is not yet fully consumed."""
+        return sum(
+            s is not None and s.prompt_remaining > 0 for s in self._slots
+        )
+
     def occupancy(self) -> Dict[str, int]:
-        """Slot gauges for health/stats reporting."""
+        """Slot gauges for health/stats reporting; ``prefilling`` vs
+        ``decoding`` splits the active count by slot lifecycle phase."""
+        prefilling = self.prefilling_count
         return {
             "slots": self.slots,
             "active": self.active_count,
             "free": self.slots - self.active_count,
+            "prefilling": prefilling,
+            "decoding": self.active_count - prefilling,
         }
 
     # -- admission ------------------------------------------------------------
@@ -279,6 +393,11 @@ class SlotEngine:
                 f"slot-multiplexed serving takes one sequence per request; "
                 f"got a batch of {prompt.shape[0]} (split it into requests)"
             )
+        # bucket check (and clamp) FIRST: in clamp mode an over-bucket
+        # prompt is cut to the largest bucket that still leaves room for
+        # max_new under the cap, so the cap check below sees the prompt
+        # that would actually be served
+        prompt = self._check_bucket(prompt, request.max_new_tokens)
         cap = self.model.cfg.max_seq_len
         if prompt.shape[1] + request.max_new_tokens > cap:
             raise ValueError(
@@ -290,23 +409,84 @@ class SlotEngine:
             session_id = request.session_id
         seed = request.seed if seed is None else seed
         rng = jax.random.PRNGKey(seed)
-        sub = prefill_carry(
-            self.model, self.params, prompt, self._sample, rng,
-            sample_index=sample_index, buckets=self.buckets,
-        )
-        self._insert(i, sub, rng, n_emitted=sample_index)
+        if self.prefill_chunk:
+            # O(1) in-scan admission: no prefill here — the prompt is
+            # staged into the carry and consumed prefill_chunk tokens per
+            # boundary inside the batched scan
+            self._stage_inscan(i, prompt, rng, sample_index)
+        else:
+            sub = prefill_carry(
+                self.model, self.params, prompt, self._sample, rng,
+                sample_index=sample_index, buckets=self.buckets,
+            )
+            self._insert(i, sub, rng, n_emitted=sample_index)
         self._slots[i] = _Slot(
             request=request,
             tag=tag,
             deadline_at=deadline_at,
             prompt=prompt,
             toks=[],
+            prompt_remaining=prompt.shape[1] if self.prefill_chunk else 0,
             session_id=session_id,
             seed=seed,
             target_new=request.max_new_tokens,
             fold_base=sample_index,
         )
         return i
+
+    def _check_bucket(self, prompt: Array, max_new: int) -> Array:
+        """A prompt longer than the largest prefill bucket never reaches
+        jit: it is REFUSED with a clean single-request error (default) or
+        clamped to the newest tokens of context (``prompt_overflow=
+        "clamp"``) — either way the compile cache stays bounded by the
+        bucket count. The clamp target is the largest bucket that still
+        leaves room for ``max_new`` under max_seq_len (with pow2 buckets
+        the largest bucket IS max_seq_len, so clamping to it would just
+        trip the capacity check instead of serving the request); if no
+        bucket leaves room, the request is refused like the error mode."""
+        if not self.buckets:
+            return prompt
+        if bucket_for(prompt.shape[1], self.buckets) is not None:
+            return prompt
+        if self.prompt_overflow == "clamp":
+            cap = self.model.cfg.max_seq_len
+            fit = [b for b in self.buckets if b + max_new <= cap]
+            if fit:
+                return prompt[:, -max(fit):]
+            raise ValueError(
+                f"prompt length {prompt.shape[1]} exceeds the largest "
+                f"prefill bucket {self.buckets[-1]} and no bucket leaves "
+                f"room for {max_new} new tokens under max_seq_len {cap}"
+            )
+        raise ValueError(
+            f"prompt length {prompt.shape[1]} exceeds the largest prefill "
+            f"bucket {self.buckets[-1]}; refuse (default) or serve the "
+            "newest bucket-sized context with prompt_overflow='clamp'"
+        )
+
+    def _stage_inscan(self, i: int, prompt: Array, rng: Array,
+                      sample_index: int) -> None:
+        """Stage one prompt for in-scan consumption: grow the staging
+        buffer to the prompt's bucket if needed (widths take bucket
+        values only — the unified program's compile key stays bounded),
+        then one fused row write (:func:`_stage_prompt_carry`)."""
+        b = bucket_for(prompt.shape[1], self.buckets)
+        width = 0 if self._pbuf is None else self._pbuf.shape[1]
+        if b > width:
+            if self._pbuf is None:
+                self._pbuf = jnp.zeros((self.slots, b), jnp.int32)
+            else:
+                self._pbuf = jnp.pad(
+                    self._pbuf, ((0, 0), (0, b - width))
+                )
+            width = b
+        row = jnp.pad(prompt, ((0, 0), (0, width - prompt.shape[1])))[0]
+        (self._carry, self._rngs, self._plen, self._pfold,
+         self._pbuf) = _stage_prompt_carry(
+            self._carry, self._rngs, self._plen, self._pfold, self._pbuf,
+            row, rng, jnp.int32(i), jnp.int32(prompt.shape[1]),
+            jnp.int32(sample_index),
+        )
 
     def resume(
         self,
@@ -363,9 +543,10 @@ class SlotEngine:
     def _insert(self, i: int, sub_carry, rng: Array, n_emitted: int = 0) -> None:
         """Row-write a solo carry (batch 1) into slot ``i`` of the batched
         carry (one fused jitted dispatch; see :func:`_insert_carry`)."""
-        self._carry, self._rngs = _insert_carry(
-            self._carry, self._rngs, sub_carry, rng,
-            jnp.int32(i), jnp.int32(n_emitted),
+        (self._carry, self._rngs, self._plen,
+         self._pfold) = _insert_carry(
+            self._carry, self._rngs, self._plen, self._pfold, sub_carry,
+            rng, jnp.int32(i), jnp.int32(n_emitted),
         )
 
     # -- the chunk step -------------------------------------------------------
@@ -388,26 +569,72 @@ class SlotEngine:
             return finished
         active = np.array([s is not None for s in self._slots])
         active_dev = jnp.asarray(active)
+        unified = self.prefilling_count > 0
         snap = self._snapshot()
-        carry, toks = self._attempt(snap, active_dev)
+        carry, toks = self._attempt(snap, active_dev, unified)
         bad = self._probe_bad(carry, active)
         if bad:
-            carry, toks, bad = self._ladder(snap, active_dev, active, carry, toks, bad)
+            carry, toks, bad = self._ladder(
+                snap, active_dev, active, carry, toks, bad, unified
+            )
             for i in sorted(bad):  # ladder exhausted: fail those requests
                 finished.append((self._slots[i].tag, self._finish(i, "failed")))
                 active[i] = False
         self._carry = carry
         done_np = self._done_np
+        piece = self._piece_tokens()
+        # host mirror of the in-scan piece: deterministic, no readback —
+        # the ACCEPTED attempt's selection (same rule over the same
+        # host-mirrored inputs) tells which slot consumed the boundary's
+        # prompt budget and hence the boundary each slot starts emitting
+        sel = self._selected_prefill_slot(active)
         for i, slot in enumerate(self._slots):
             if slot is None or not active[i]:
                 continue
-            slot.toks.append((toks, i))
-            slot.n_emitted += self.chunk
-            slot.chunks += 1
+            if slot.prompt_remaining > 0:
+                slot.chunks += 1
+                if i != sel:
+                    continue  # frozen: another slot had the budget
+                slot.prompt_remaining -= min(piece, slot.prompt_remaining)
+                if slot.prompt_remaining > 0:
+                    continue  # still mid-prefill: emitted nothing yet
+                slot.toks.append((toks, i))
+                slot.n_emitted += self.chunk
+            else:
+                slot.toks.append((toks, i))
+                slot.n_emitted += self.chunk
+                slot.chunks += 1
             if slot.n_emitted >= slot.target_new or done_np[i]:
                 finished.append((slot.tag, self._finish(i, "ok")))
         self._chunk_counter += 1
         return finished
+
+    def _piece_tokens(self) -> int:
+        """The boundary's TOTAL prompt-token budget (Sarathi-style
+        rate-limit knob), capped at the staged buffer's width (a single
+        piece then covers any prompt the buffer holds — which also keeps
+        piece boundaries trivially chunk-aligned)."""
+        if not self.prefill_chunk or self._pbuf is None:
+            return self.prefill_chunk
+        return min(self.prefill_chunk, self._pbuf.shape[1])
+
+    def _selected_prefill_slot(self, active) -> Optional[int]:
+        """Host mirror of the unified program's stage-1 selection:
+        shortest remaining prompt first, ties to the lowest slot index —
+        computed from the same inputs the device argmin sees (the
+        host-tracked remaining counts), so the schedule is known without
+        a device round-trip. Must be evaluated against the mask of the
+        ACCEPTED attempt (ladder rung 3 can mask a prefilling slot out,
+        moving the budget to its neighbour in the replay)."""
+        best = None
+        for i, slot in enumerate(self._slots):
+            if slot is None or not active[i] or slot.prompt_remaining <= 0:
+                continue
+            if (best is None
+                    or slot.prompt_remaining
+                    < self._slots[best].prompt_remaining):
+                best = i
+        return best
 
     def _snapshot(self):
         """Container-fresh snapshot of the batched carry (O(1): jax arrays
@@ -417,14 +644,24 @@ class SlotEngine:
         token, states, t, emit, done = self._carry
         return (token, snapshot_decode_state(states), t, emit, done)
 
-    def _attempt(self, carry, active_dev):
-        """One batched chunk attempt; applies any armed per-slot (or
-        legacy per-chunk) decode-state poisoning afterwards so each ladder
-        rung is deterministically reachable per slot."""
-        out, toks = decode_batched_chunk(
-            self.model, self.params, carry, self._rngs, active_dev,
-            self.chunk, self._sample,
-        )
+    def _attempt(self, carry, active_dev, unified=False):
+        """One batched chunk attempt — the UNIFIED prefill+decode program
+        while any slot is mid-prefill, the pure decode program otherwise
+        (whose compiled bytes this feature must not perturb; golden
+        ``decode_batched_tiny``). Applies any armed per-slot (or legacy
+        per-chunk) decode-state poisoning afterwards so each ladder rung
+        is deterministically reachable per slot."""
+        if unified:
+            out, toks = decode_batched_prefill_chunk(
+                self.model, self.params, carry, self._rngs, active_dev,
+                self._pbuf, self._plen, self._pfold, self.chunk,
+                self.prefill_chunk, self._sample,
+            )
+        else:
+            out, toks = decode_batched_chunk(
+                self.model, self.params, carry, self._rngs, active_dev,
+                self.chunk, self._sample,
+            )
         if inject.active():
             for i, slot in enumerate(self._slots):
                 if slot is None:
@@ -457,15 +694,17 @@ class SlotEngine:
         finite = flags[0]
         return {i for i in range(self.slots) if active[i] and not finite[i]}
 
-    def _ladder(self, snap, active_dev, active, carry, toks, bad):
+    def _ladder(self, snap, active_dev, active, carry, toks, bad, unified=False):
         """Walk the per-slot degradation ladder. Redoing the WHOLE batched
         chunk from the boundary snapshot is the rewind: deterministic
         row-independent compute means untouched slots reproduce their
-        tokens bitwise, and the poisoned slot gets its retry. Returns the
-        accepted (carry, toks) and the set of slots whose ladder is
+        tokens bitwise (a co-resident slot MID-prefill replays its piece
+        identically — the staged prompt and its position are part of the
+        snapshot's inputs), and the poisoned slot gets its retry. Returns
+        the accepted (carry, toks) and the set of slots whose ladder is
         exhausted (their requests fail; everyone else streams on)."""
         # rung 1: rewind — redo from the snapshot
-        carry, toks = self._attempt(snap, active_dev)
+        carry, toks = self._attempt(snap, active_dev, unified)
         bad2 = self._probe_bad(carry, active)
         for i in bad:
             self._slots[i].rewinds += 1
@@ -478,7 +717,7 @@ class SlotEngine:
         for i in sorted(bad2):
             snap2 = self._reprefill_into(snap2, i)
             self._slots[i].reprefills += 1
-        carry, toks = self._attempt(snap2, active_dev)
+        carry, toks = self._attempt(snap2, active_dev, unified)
         bad3 = self._probe_bad(carry, active)
         if not bad3:
             return carry, toks, set()
@@ -488,7 +727,7 @@ class SlotEngine:
         for i in bad3:
             still[i] = False
         if still.any():
-            carry, toks = self._attempt(snap2, jnp.asarray(still))
+            carry, toks = self._attempt(snap2, jnp.asarray(still), unified)
         return carry, toks, bad3
 
     def _reprefill_into(self, snap, i: int):
@@ -501,6 +740,14 @@ class SlotEngine:
         is anchored at ``fold_base`` so the rebuilt rng walk matches the
         carry the snapshot held."""
         slot = self._slots[i]
+        if slot.prompt_remaining > 0:
+            # mid-prefill: nothing emitted yet — the one known-good input
+            # is the staged prompt itself, so this rung RESTARTS the
+            # in-scan prefill from a zero state row (no host-side prefill
+            # sneaks back onto the admission path; the tokens come out
+            # bitwise-identical, a few boundaries later)
+            slot.prompt_remaining = slot.prompt.shape[1]
+            return _restart_prefill_row(snap, jnp.int32(i))
         emitted = list(slot.prior) + [
             arr[row : row + 1] for arr, row in slot.toks
         ]
@@ -510,8 +757,8 @@ class SlotEngine:
             self.model, self.params, slot.prompt, emitted, self._sample,
             rng, buckets=self.buckets, sample_index=fold,
         )
-        new_snap, self._rngs = _insert_carry(
-            snap, self._rngs, sub, rng,
+        new_snap, self._rngs, self._plen, self._pfold = _insert_carry(
+            snap, self._rngs, self._plen, self._pfold, sub, rng,
             jnp.int32(i), jnp.int32(fold),
         )
         return new_snap
@@ -556,9 +803,14 @@ class SlotEngine:
         session id and its state is trustworthy. ``failed`` never
         suspends: a ladder-exhausted slot's state is exactly what a
         continuation must NOT resume from (the previous generation on
-        disk stays the session's truth)."""
+        disk stays the session's truth). A slot still MID-prefill never
+        suspends either — its carry is a partial prompt, not a turn
+        boundary; it evicts with zero tokens and whatever the session
+        store already holds stays that conversation's truth (the client
+        re-submits the turn)."""
         slot = self._slots[i]
-        if slot.session_id is None or status == "failed":
+        if (slot.session_id is None or status == "failed"
+                or slot.prompt_remaining > 0):
             return self._evict(i, status)
         return self._suspend(i, status)
 

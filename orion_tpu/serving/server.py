@@ -86,6 +86,15 @@ class ServeConfig:
     grace: float = 30.0  # SIGTERM drain budget, as in training
     poll: float = 0.05  # idle queue poll cadence (seconds)
     prefill_buckets: str = "pow2"  # pad-to-bucket prompt lengths ("" = off)
+    # in-scan chunked prefill: prompt tokens consumed per chunk boundary
+    # inside the batched scan (rate-limits prefill against resident
+    # decoders; rounded up to the linear-attention chunk). 0 = legacy
+    # host-thread prefill at admission (the head-of-line-blocking path,
+    # kept for comparison benches).
+    prefill_chunk: int = 64
+    # prompts longer than the largest prefill bucket: "error" refuses the
+    # request cleanly; "clamp" serves the newest bucket-sized context
+    prompt_overflow: str = "error"
     # -- durable sessions (session_store.py); None = sessions disabled --
     session_dir: Optional[str] = None  # on-disk session store root
     session_idle_s: float = 300.0  # resident-cache idle eviction (0 = off)
@@ -162,6 +171,8 @@ class Server:
             prefill_buckets=parse_buckets(
                 cfg.prefill_buckets, model.cfg.max_seq_len
             ),
+            prefill_chunk=cfg.prefill_chunk,
+            prompt_overflow=cfg.prompt_overflow,
         )
         self.health = HealthMachine(clock=clock)
         # durable sessions: write-through disk store + a host-resident LRU

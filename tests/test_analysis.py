@@ -514,6 +514,55 @@ def schedule(engine, slots):
     )
 
 
+def test_decode_host_sync_admission_path_is_sync_free():
+    """ISSUE 7: in-scan prefill makes admission an O(1) slot insert, so a
+    host sync inside an admit/insert/stage-named function of the engine
+    is a finding even OUTSIDE a loop (a per-admit device round-trip on
+    the scheduler's hot path is the stall the unified path kills)."""
+    synced = """
+import numpy as np
+
+def admit(engine, prompt):
+    state = engine.prefill(prompt)
+    return np.asarray(state)
+
+def _stage_prompt(engine, prompt):
+    return float(engine.park(prompt))
+"""
+    found = rule_ids(
+        lint_source(synced, path="orion_tpu/serving/batching.py")
+    )
+    assert "decode-host-sync" in found
+    # the clean O(1) shape: staging dispatches device work, syncs nothing
+    clean = """
+import jax.numpy as jnp
+
+def admit(engine, prompt, i):
+    row = jnp.pad(prompt, ((0, 0), (0, engine.width - prompt.shape[1])))
+    engine.stage_row(row, i)
+    return i
+
+def _insert(engine, carry, i):
+    return engine.write_row(carry, i)
+"""
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(clean, path="orion_tpu/serving/batching.py")
+    )
+    # the budget is the ENGINE's: admission helpers elsewhere (even other
+    # decode modules) keep the loop-scoped rule only
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(synced, path="orion_tpu/serving/server.py")
+    )
+    # probe-named designated syncs stay exempt inside the engine too
+    probed = """
+def _admit_probe(engine):
+    return float(engine.flags())
+"""
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(probed, path="orion_tpu/serving/batching.py")
+    )
+
+
 def test_loop_accum_only_fires_on_hot_paths():
     src = """
 import jax.numpy as jnp

@@ -1,0 +1,454 @@
+"""In-scan chunked prefill suite (ISSUE 7): admission without the stall.
+
+The two acceptance proofs live here — (1) a request admitted by STAGING
+its prompt into the carry and consuming it ``prefill_chunk`` tokens per
+boundary inside the batched scan emits tokens BITWISE-identical to the
+host-prefill path (and to the solo monolithic scan) at the same seed, for
+slot counts {2, 4, 8}, greedy and sampled, staggered admission, prompt
+lengths straddling bucket / linear-chunk / piece boundaries; and (2) the
+engine's lifetime decode-compile count stays one per
+(slots, chunk, prompt_bucket) and admission itself never compiles or
+runs a prefill. Plus the satellite coverage: ladder rungs fired while a
+co-resident slot is mid-prefill, bucket-overflow refusal/clamping before
+any jit, mid-prefill deadline/drain behaviour, and a PR 6 session
+suspended and resumed across an in-scan-admitted turn.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.generate import (
+    SampleConfig,
+    _decode_batched_chunk_jit,
+    _decode_batched_prefill_chunk_jit,
+    _prefill_carry_bucketed_jit,
+    _prefill_carry_jit,
+    generate,
+)
+from orion_tpu.models.configs import ModelConfig
+from orion_tpu.models.transformer import TransformerLM, init_decode_state
+from orion_tpu.resilience import inject
+from orion_tpu.serving import (
+    DecodeRequest,
+    ServeConfig,
+    Server,
+    SlotEngine,
+)
+
+pytestmark = pytest.mark.chaos
+
+# one layer of each attention type, small linear-attention chunk (4) so a
+# modest prefill_chunk already spans several chunks and piece boundaries
+# land between/on chunk edges
+CFG = ModelConfig(
+    name="inscan_test", vocab_size=64, d_model=32, n_layers=3, n_heads=2,
+    layer_types=("linear", "softmax", "swa"), window=4, max_seq_len=96,
+    dtype="float32", backend="xla", chunk=4,
+)
+GREEDY = SampleConfig(temperature=0.0)
+SAMPLED = SampleConfig(temperature=0.8, top_k=5, top_p=0.9, eos_token=3,
+                       pad_token=0)
+BUCKETS = (8, 16, 32)
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = TransformerLM(CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _prompt(i, ln):
+    return jax.random.randint(
+        jax.random.PRNGKey(3000 + i), (1, ln), 0, CFG.vocab_size
+    ).astype(jnp.int32)
+
+
+def _engine(mp, mode, slots=2, chunk=4, **kw):
+    model, params = mp
+    return SlotEngine(
+        model, params, slots=slots, chunk=chunk, prefill_buckets=BUCKETS,
+        prefill_chunk=8 if mode == "inscan" else 0, **kw,
+    )
+
+
+def _drain(eng):
+    done = {}
+    while eng.busy:
+        done.update(dict(eng.step()))
+    return done
+
+
+# ---------------------------------------------------------------------------
+# model layer: piecewise prefill_extend == monolithic prefill, bitwise
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("plen,pchunk", [
+    (5, 8),    # single piece shorter than the piece
+    (8, 8),    # exact piece
+    (19, 8),   # multi-piece, ragged tail straddling linear chunks (4)
+    (13, 4),   # piece == linear-attention chunk
+    (31, 12),  # piece = 3 linear chunks, ragged tail
+])
+def test_prefill_extend_pieces_bitwise_equal_monolithic(mp, plen, pchunk):
+    """Piece-by-piece prefill_extend_step replays monolithic prefill's
+    exact op sequence: (S, z), the KV cache's real rows, the ring's
+    readable rows, and the last-real-row logits are all BITWISE equal —
+    the identity the in-scan admission path is built on."""
+    model, params = mp
+    bucket = -(-plen // 8) * 8
+    tokens = _prompt(plen, plen)
+    padded = jnp.pad(tokens, ((0, 0), (0, bucket - plen)))
+    ref_logits, ref_states = model.apply(
+        params, padded, jnp.int32(plen), method="prefill_last"
+    )
+    states = init_decode_state(CFG, 1)
+    logits, off = None, 0
+    while off < plen:
+        cons = min(pchunk, plen - off)
+        idx = jnp.clip(off + jnp.arange(pchunk), 0, padded.shape[1] - 1)
+        piece = jnp.take(padded, idx, axis=1)
+        logits, states = model.apply(
+            params, piece, states, jnp.int32(off), jnp.int32(cons),
+            method="prefill_extend_step",
+        )
+        off += cons
+    np.testing.assert_array_equal(np.asarray(ref_logits), np.asarray(logits))
+    for li, (lt, sr, sg) in enumerate(
+        zip(CFG.layer_types, ref_states, states)
+    ):
+        for key in sr:
+            a, b = np.asarray(sr[key]), np.asarray(sg[key])
+            if lt == "softmax":
+                a, b = a[:, :, :plen], b[:, :, :plen]
+            if lt == "swa":
+                pos = np.arange(max(0, plen - CFG.window), plen)
+                a, b = a[:, :, pos % CFG.window], b[:, :, pos % CFG.window]
+            np.testing.assert_array_equal(a, b, err_msg=f"layer{li}.{key}")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: in-scan vs host-prefill admission, bitwise, engine-level
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("slots", [2, 4, 8])
+@pytest.mark.parametrize("sample", [GREEDY, SAMPLED], ids=["greedy", "sampled"])
+def test_inscan_bitwise_equals_host_prefill_staggered(mp, slots, sample):
+    """Staggered admission (one new request per boundary) with prompt
+    lengths straddling bucket edges (8/16) and piece/linear-chunk
+    boundaries: every request's tokens through the in-scan engine are
+    BITWISE what the host-prefill engine and the solo scan emit."""
+    model, params = mp
+    lengths = [3, 8, 9, 16, 17, 21][: slots + 2]
+    prompts = [_prompt(i, ln) for i, ln in enumerate(lengths)]
+    refs = [
+        np.asarray(generate(model, params, p, 8, sample,
+                            rng=jax.random.PRNGKey(500 + i)))
+        for i, p in enumerate(prompts)
+    ]
+    results = {}
+    for mode in ("host", "inscan"):
+        eng = _engine(mp, mode, slots=slots)
+        done, pending = {}, list(enumerate(prompts))
+        while pending or eng.busy:
+            if pending and eng.has_free_slot:
+                i, p = pending.pop(0)  # ONE admission per boundary
+                eng.admit(DecodeRequest(prompt=p, max_new_tokens=8,
+                                        sample=sample, seed=500 + i), tag=i)
+            done.update(dict(eng.step()))
+        results[mode] = done
+    for i, ref in enumerate(refs):
+        for mode in ("host", "inscan"):
+            r = results[mode][i]
+            assert r.status == "ok", (mode, i)
+            np.testing.assert_array_equal(
+                r.tokens, ref, err_msg=f"{mode} slots={slots} request {i}"
+            )
+
+
+def test_admission_is_o1_no_prefill_compile_no_prompt_work(mp):
+    """In-scan admission must not touch the prefill jits at all (the
+    bucket-overflow satellite's stronger sibling): serving prompts of
+    many lengths leaves BOTH host-prefill compile caches untouched, and
+    the unified program compiles once per (slots, chunk, bucket)."""
+    model, params = mp
+    pb_before = _prefill_carry_bucketed_jit._cache_size()
+    pe_before = _prefill_carry_jit._cache_size()
+    un_before = _decode_batched_prefill_chunk_jit._cache_size()
+    de_before = _decode_batched_chunk_jit._cache_size()
+    eng = _engine(mp, "inscan", slots=3, chunk=3)
+    done = {}
+    for i, ln in enumerate([3, 5, 7, 8, 4, 6, 2]):  # all in bucket 8
+        eng.admit(DecodeRequest(prompt=_prompt(50 + i, ln),
+                                max_new_tokens=6, sample=GREEDY,
+                                seed=100 + i), tag=i)
+        done.update(dict(eng.step()))
+    done.update(_drain(eng))
+    assert all(r.status == "ok" for r in done.values())
+    assert _prefill_carry_bucketed_jit._cache_size() == pb_before, (
+        "in-scan admission ran a host-side bucketed prefill"
+    )
+    assert _prefill_carry_jit._cache_size() == pe_before, (
+        "in-scan admission ran a host-side exact-length prefill"
+    )
+    assert _decode_batched_prefill_chunk_jit._cache_size() - un_before == 1, (
+        "the unified program must compile once per (slots, chunk, bucket)"
+    )
+    assert _decode_batched_chunk_jit._cache_size() - de_before <= 1
+
+
+def test_unified_compiles_once_per_bucket(mp):
+    """Prompt lengths crossing into a bigger bucket add exactly ONE
+    unified compile (the staged buffer's width is the compile key);
+    lengths within a bucket never add one."""
+    model, params = mp
+    eng = _engine(mp, "inscan", slots=2, chunk=5)
+    before = _decode_batched_prefill_chunk_jit._cache_size()
+    for i, ln in enumerate([3, 7, 8]):  # bucket 8
+        eng.admit(DecodeRequest(prompt=_prompt(70 + i, ln),
+                                max_new_tokens=5, sample=GREEDY, seed=i),
+                  tag=("a", i))
+        _drain(eng)
+    assert _decode_batched_prefill_chunk_jit._cache_size() - before == 1
+    for i, ln in enumerate([9, 13, 16]):  # bucket 16: one more width
+        eng.admit(DecodeRequest(prompt=_prompt(80 + i, ln),
+                                max_new_tokens=5, sample=GREEDY, seed=i),
+                  tag=("b", i))
+        _drain(eng)
+    assert _decode_batched_prefill_chunk_jit._cache_size() - before == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: bucket overflow never reaches jit
+# ---------------------------------------------------------------------------
+
+
+def test_prompt_overflow_is_clean_error_before_any_jit(mp):
+    """A prompt longer than the largest bucket is refused at admission —
+    no prefill compile, no unified compile, no slot claimed — in BOTH
+    admission modes."""
+    model, params = mp
+    long_prompt = _prompt(0, BUCKETS[-1] + 5)
+    for mode in ("inscan", "host"):
+        eng = _engine(mp, mode)
+        pb = _prefill_carry_bucketed_jit._cache_size()
+        pe = _prefill_carry_jit._cache_size()
+        un = _decode_batched_prefill_chunk_jit._cache_size()
+        with pytest.raises(ValueError, match="largest prefill bucket"):
+            eng.admit(DecodeRequest(prompt=long_prompt, max_new_tokens=4,
+                                    sample=GREEDY, seed=0))
+        assert not eng.busy, "the refused request must not hold a slot"
+        assert _prefill_carry_bucketed_jit._cache_size() == pb
+        assert _prefill_carry_jit._cache_size() == pe
+        assert _decode_batched_prefill_chunk_jit._cache_size() == un
+
+
+def test_prompt_overflow_clamp_serves_newest_context(mp):
+    """prompt_overflow='clamp': the request is served from the newest
+    tokens of the largest bucket that still leaves room for max_new
+    under max_seq_len — bitwise what admitting the pre-clamped prompt
+    produces. (The cap-aware choice matters: with pow2 buckets the
+    largest bucket IS max_seq_len, so a naive clamp to buckets[-1]
+    would just trip the capacity check.)"""
+    model, params = mp
+    long_prompt = _prompt(1, BUCKETS[-1] + 7)
+    clamped = long_prompt[:, -BUCKETS[-1]:]  # 32 + 8 new <= cap 96
+    ref = np.asarray(generate(model, params, clamped, 8, GREEDY,
+                              rng=jax.random.PRNGKey(11)))
+    eng = _engine(mp, "inscan", prompt_overflow="clamp")
+    eng.admit(DecodeRequest(prompt=long_prompt, max_new_tokens=8,
+                            sample=GREEDY, seed=11), tag="r")
+    done = _drain(eng)
+    assert done["r"].status == "ok"
+    np.testing.assert_array_equal(done["r"].tokens, ref)
+    # max_new 70: bucket 32 no longer fits under cap 96 -> clamp picks 16
+    eng2 = _engine(mp, "inscan", prompt_overflow="clamp")
+    i = eng2.admit(DecodeRequest(prompt=long_prompt, max_new_tokens=70,
+                                 sample=GREEDY, seed=12), tag="r2")
+    assert eng2._slots[i].prompt.shape[1] == 16
+    # and when NO bucket leaves room, clamp refuses like the error mode
+    with pytest.raises(ValueError, match="no bucket leaves room"):
+        eng2.admit(DecodeRequest(prompt=long_prompt, max_new_tokens=95,
+                                 sample=GREEDY, seed=13))
+
+
+def test_inscan_requires_buckets_loudly(mp):
+    """In-scan prefill with prefill_buckets off must refuse at engine
+    construction (a silent pow2 override would ignore the user's
+    explicit choice), pointing at the two valid configurations."""
+    model, params = mp
+    with pytest.raises(ValueError, match="prefill_buckets"):
+        SlotEngine(model, params, slots=2, chunk=4, prefill_chunk=8)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the ladder with a co-resident slot mid-prefill
+# ---------------------------------------------------------------------------
+
+
+def test_rewind_during_neighbour_prefill_bitwise(mp):
+    """Rung 1 fired on a DECODING slot while its neighbour is mid-prefill:
+    the rewound boundary replays the neighbour's piece identically — both
+    requests finish bitwise."""
+    model, params = mp
+    p0, p1 = _prompt(10, 5), _prompt(11, 30)  # p1: 4 pieces at pchunk=8
+    refs = [
+        np.asarray(generate(model, params, p, 8, GREEDY,
+                            rng=jax.random.PRNGKey(500 + i)))
+        for i, p in enumerate((p0, p1))
+    ]
+    eng = _engine(mp, "inscan")
+    eng.admit(DecodeRequest(prompt=p0, max_new_tokens=8, sample=GREEDY,
+                            seed=500), tag=0)
+    done = dict(eng.step())  # slot 0 decodes its first chunk
+    eng.admit(DecodeRequest(prompt=p1, max_new_tokens=8, sample=GREEDY,
+                            seed=501), tag=1)
+    # chunk 1 (slot-0-local chunk index 1): slot 1 is mid-prefill
+    plan = inject.FaultPlan().poison_decode_slot_at(0, chunk=1)
+    with inject.inject(plan):
+        done.update(_drain(eng))
+    assert plan.delivered == ["decode.slot_nan.0@1"]
+    assert done[0].rewinds == 1 and done[0].status == "ok"
+    assert done[1].status == "ok" and done[1].rewinds == 0
+    for i in range(2):
+        np.testing.assert_array_equal(done[i].tokens, refs[i])
+
+
+def test_reprefill_rung_restarts_midprefill_slot_bitwise(mp):
+    """Rungs 1+2 fired on a slot STILL MID-PREFILL: rung 2 cannot rebuild
+    from emitted tokens (there are none) — it restarts the in-scan
+    prefill from a zero state row. Tokens still come out bitwise; the
+    co-resident decoder streams untouched."""
+    model, params = mp
+    p0, p1 = _prompt(20, 5), _prompt(21, 30)
+    refs = [
+        np.asarray(generate(model, params, p, 8, GREEDY,
+                            rng=jax.random.PRNGKey(600 + i)))
+        for i, p in enumerate((p0, p1))
+    ]
+    eng = _engine(mp, "inscan")
+    eng.admit(DecodeRequest(prompt=p0, max_new_tokens=8, sample=GREEDY,
+                            seed=600), tag=0)
+    eng.admit(DecodeRequest(prompt=p1, max_new_tokens=8, sample=GREEDY,
+                            seed=601), tag=1)
+    # slot 1's chunk 1 is mid-prefill (pieces of 8 over a 30-token
+    # prompt); two deliveries poison the rewind retry too -> rung 2
+    plan = inject.FaultPlan().poison_decode_slot_at(1, chunk=1, times=2)
+    with inject.inject(plan):
+        done = _drain(eng)
+    assert (done[1].rewinds, done[1].reprefills) == (1, 1)
+    assert done[0].rewinds == 0
+    for i in range(2):
+        assert done[i].status == "ok", i
+        np.testing.assert_array_equal(done[i].tokens, refs[i],
+                                      err_msg=f"request {i}")
+
+
+def test_deadline_mid_prefill_evicts_with_zero_tokens(mp):
+    """A deadline expiring while the slot is still consuming its prompt
+    evicts cleanly with zero tokens; the co-resident request streams."""
+    model, params = mp
+    p0, p1 = _prompt(30, 5), _prompt(31, 30)
+    ref0 = np.asarray(generate(model, params, p0, 12, GREEDY,
+                               rng=jax.random.PRNGKey(700)))
+    now = [0.0]
+    eng = _engine(mp, "inscan", clock=lambda: now[0])
+    eng.admit(DecodeRequest(prompt=p0, max_new_tokens=12, sample=GREEDY,
+                            seed=700), tag="fast")
+    eng.admit(DecodeRequest(prompt=p1, max_new_tokens=12, sample=GREEDY,
+                            seed=701), tag="tight", deadline_at=1.5)
+    done = {}
+    while eng.busy:
+        done.update(dict(eng.step()))
+        now[0] += 1.0
+    assert done["tight"].status == "deadline"
+    assert done["tight"].new_tokens == 0, "still mid-prefill at expiry"
+    assert done["fast"].status == "ok"
+    np.testing.assert_array_equal(done["fast"].tokens, ref0)
+
+
+# ---------------------------------------------------------------------------
+# PR 6 sessions x in-scan admission
+# ---------------------------------------------------------------------------
+
+
+def test_session_suspend_resume_across_inscan_admission(mp, tmp_path):
+    """A session whose first turn was admitted VIA IN-SCAN PREFILL
+    suspends at turn end and resumes O(1) for turn 2 — the concatenated
+    turns are bitwise one longer uninterrupted request (the PR 6 contract
+    must survive the new admission path)."""
+    model, params = mp
+    prompt = _prompt(40, 21)  # 3 pieces at pchunk=8
+    ref = np.asarray(generate(model, params, prompt, 16, SAMPLED,
+                              rng=jax.random.PRNGKey(900)))
+    cfg = ServeConfig(chunk=4, slots=2, max_inflight=4,
+                      prefill_buckets="8,16,32", prefill_chunk=8,
+                      session_dir=str(tmp_path / "sessions"))
+    srv = Server(model, params, cfg)
+    p1 = srv.submit(DecodeRequest(prompt=prompt, max_new_tokens=8,
+                                  sample=SAMPLED, seed=900, session_id="s"))
+    assert srv.serve(drain_when_idle=True) == 0
+    assert p1.result.status == "ok"
+    np.testing.assert_array_equal(p1.result.tokens, ref[:, :8])
+    # turn 2: empty-prompt continuation -> O(1) resume, no prefill
+    p2 = srv.submit(DecodeRequest(prompt=np.zeros((1, 0), np.int32),
+                                  max_new_tokens=8, sample=SAMPLED,
+                                  seed=900, session_id="s"))
+    assert srv.serve(drain_when_idle=True) == 0
+    assert p2.result.status == "ok"
+    np.testing.assert_array_equal(p2.result.tokens, ref[:, 8:16])
+    srv.close()
+
+
+def test_drain_mid_prefill_suspends_without_snapshot(mp, tmp_path):
+    """SIGTERM drain while a session turn is STILL MID-PREFILL: the slot
+    comes back 'suspended' with zero tokens and NO snapshot persisted —
+    the store keeps whatever it held, and a re-submitted turn serves
+    bitwise from scratch."""
+    model, params = mp
+    prompt = _prompt(41, 30)
+    ref = np.asarray(generate(model, params, prompt, 8, GREEDY,
+                              rng=jax.random.PRNGKey(901)))
+    cfg = ServeConfig(chunk=4, slots=2, max_inflight=4,
+                      prefill_buckets="8,16,32", prefill_chunk=8,
+                      session_dir=str(tmp_path / "sessions"))
+    srv = Server(model, params, cfg)
+    p1 = srv.submit(DecodeRequest(prompt=prompt, max_new_tokens=8,
+                                  sample=GREEDY, seed=901, session_id="d"))
+    plan = inject.FaultPlan().preempt_at_chunk(0)  # signal at boundary 0
+    with inject.inject(plan):
+        assert srv.serve() == 0
+    assert p1.result is not None and p1.result.status == "suspended"
+    assert p1.result.new_tokens == 0
+    assert p1.result.session is None, "a partial prompt is not a turn"
+    # a fresh server serves the re-submitted turn bitwise from scratch
+    srv2 = Server(model, params, cfg)
+    p2 = srv2.submit(DecodeRequest(prompt=prompt, max_new_tokens=8,
+                                   sample=GREEDY, seed=901, session_id="d"))
+    assert srv2.serve(drain_when_idle=True) == 0
+    assert p2.result.status == "ok"
+    np.testing.assert_array_equal(p2.result.tokens, ref)
+    srv2.close()
+
+
+def test_occupancy_distinguishes_prefilling_from_decoding(mp):
+    model, params = mp
+    eng = _engine(mp, "inscan")
+    eng.admit(DecodeRequest(prompt=_prompt(60, 5), max_new_tokens=8,
+                            sample=GREEDY, seed=0), tag=0)
+    eng.admit(DecodeRequest(prompt=_prompt(61, 30), max_new_tokens=8,
+                            sample=GREEDY, seed=1), tag=1)
+    occ = eng.occupancy()
+    assert occ["active"] == 2
+    assert occ["prefilling"] == 2  # nothing consumed before the 1st step
+    eng.step()
+    occ = eng.occupancy()
+    assert occ["prefilling"] == 1 and occ["decoding"] == 1
+    _drain(eng)
+    occ = eng.occupancy()
+    assert occ["prefilling"] == 0 and occ["active"] == 0

@@ -576,7 +576,10 @@ def test_resume_reuses_existing_decode_compile(mp, tmp_path):
     global cache delta is attributable."""
     model, params = mp
     prompt = _prompt(70)
-    cfgkw = dict(slots=5, chunk=3, prefill_buckets="")
+    # host-prefill mode: bucketing off (exact-length prefill) is the
+    # configuration whose compile caches this test counts — in-scan
+    # staging (prefill_chunk > 0) requires buckets and never prefills
+    cfgkw = dict(slots=5, chunk=3, prefill_buckets="", prefill_chunk=0)
     srv1 = Server(model, params, _serve_cfg(tmp_path, **cfgkw))
     _run_turn(srv1, prompt, 6, GREEDY, 1, "conv")
     srv1.close()
