@@ -126,6 +126,15 @@ class ModuleContext:
         ) or p.startswith("ops/")
 
     @property
+    def is_fleet(self) -> bool:
+        """The replicated-serving layer (orion_tpu/fleet/): every
+        cross-process wait — control-channel reads, child joins, event
+        waits — must carry a timeout, because the peer is a separate OS
+        process that can die or wedge at any time (the unbounded-wait
+        rule widens its method set here)."""
+        return "fleet/" in self.path
+
+    @property
     def is_pallas_module(self) -> bool:
         return "ops/pallas/" in self.path and not self.path.endswith(
             "__init__.py"

@@ -16,6 +16,13 @@ thread/process join. Calls carrying a ``timeout=`` kwarg pass. Test code is
 exempt (tests may legitimately block on a result); real exceptions use the
 standard ``# orion: noqa[unbounded-wait]`` / baseline escape hatch.
 
+In ``orion_tpu/fleet/`` the rule's method set widens to ``.wait()`` and
+``.recv()``: there the peer of a wait is a child OS process (a replica)
+that can be SIGKILLed or wedge in a C call — ``Popen.wait()``,
+``Event.wait()``, and pipe ``recv()`` without timeouts park the
+supervisor on a corpse, which is exactly the outcome the fleet's
+heartbeat machinery exists to prevent.
+
 ``signal-unsafe-handler`` — a Python signal handler runs between two
                      arbitrary bytecodes of whatever the main thread was
                      doing. Buffered I/O (``print``, ``open``,
@@ -48,31 +55,43 @@ class UnboundedWaitRule:
     id = "unbounded-wait"
     title = "unbounded blocking wait (no timeout)"
 
+    # in orion_tpu/fleet/ the peer of a wait is another OS PROCESS —
+    # a child replica that can be SIGKILLed, OOM-killed, or wedged in a
+    # C call at any time — so the method set widens: ``.wait()`` (process
+    # wait / event wait) and ``.recv()`` (pipe read) without a timeout
+    # park the parent forever on a corpse. Everywhere else those names
+    # are too ambiguous to flag (a module-level ``wait`` helper, a
+    # socket recv behind its own settimeout); the fleet's supervision
+    # contract is precisely "every cross-process wait is bounded".
+    _FLEET_METHODS = ("get", "join", "wait", "recv")
+
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         if ctx.is_test:
             return
+        methods = self._FLEET_METHODS if ctx.is_fleet else ("get", "join")
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call) or not isinstance(
                 node.func, ast.Attribute
             ):
                 continue
             meth = node.func.attr
-            if meth not in ("get", "join"):
+            if meth not in methods:
                 continue
             if node.args:
-                continue  # dict.get(key), "sep".join(parts), path.join(...)
+                continue  # dict.get(key), "sep".join(parts), wait(5.0), ...
             kws = {k.arg for k in node.keywords}
             if "timeout" in kws:
                 continue
             if meth == "get" and kws - {"block"}:
                 continue  # keyword'd non-queue .get()
-            if meth == "join" and kws:
+            if meth in ("join", "wait", "recv") and kws:
                 continue
             yield Finding(
                 self.id, ctx.path, node.lineno,
                 f".{meth}() with no timeout blocks forever if the peer "
-                "thread is dead or hung — pass timeout= and surface a "
-                "StallError (resilience/watchdog.py), or suppress with "
+                "thread (or, in fleet/, the peer PROCESS) is dead or hung "
+                "— pass timeout= and surface the stall "
+                "(resilience/watchdog.py), or suppress with "
                 "# orion: noqa[unbounded-wait]",
             )
 

@@ -1,0 +1,43 @@
+"""Fleet: a replicated front door that turns "a server" into "a service".
+
+The paper's O(1) recurrent decode state is what makes this layer thin: a
+conversation is one small ``(S, z)`` pytree on a SHARED session store, so
+any replica can resume any session from disk — replication needs a
+router, not a cache fabric. The pieces:
+
+- :mod:`replica` — :class:`ReplicaHandle` transports: a
+  :class:`ProcessReplica` runs a full ``serving.Server`` in a real child
+  OS process behind a line-delimited JSON control channel (SIGTERM =
+  drain, sessions suspend to the shared store); a :class:`LocalReplica`
+  drives the same server on a thread (tests, ``--local`` debugging).
+- :mod:`router` — :class:`Router`: admission-aware least-loaded dispatch
+  that routes around DEGRADED/DRAINING/DEAD replicas, sheds with
+  ``OverloadError`` at the fleet admission bound (the PR 4 single-server
+  contract, one level up), fails over mid-dispatch when a replica's
+  channel breaks, and serializes turns per conversation fleet-wide.
+- :mod:`supervisor` — :class:`Supervisor`: heartbeats, degraded ⇒
+  SIGTERM-drain-and-respawn (in-flight conversations continue elsewhere
+  with zero lost turns), exit ⇒ respawn, spawn retries.
+
+``python -m orion_tpu.fleet`` is the CLI (``--replicas --session-dir
+--max-inflight`` plus the engine knobs ``--slots --chunk
+--prefill-chunk``). Chaos coverage: tests/test_fleet.py (marker
+``chaos``) — cross-replica session mobility is proven BITWISE-identical
+to an uninterrupted solo run, through drain and through kill.
+"""
+
+from orion_tpu.fleet.replica import (
+    FleetPending,
+    LocalReplica,
+    ProcessReplica,
+    ReplicaGone,
+    ReplicaHandle,
+    ReplicaSpec,
+)
+from orion_tpu.fleet.router import Router
+from orion_tpu.fleet.supervisor import Supervisor
+
+__all__ = [
+    "FleetPending", "LocalReplica", "ProcessReplica", "ReplicaGone",
+    "ReplicaHandle", "ReplicaSpec", "Router", "Supervisor",
+]

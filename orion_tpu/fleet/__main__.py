@@ -1,0 +1,209 @@
+"""``python -m orion_tpu.fleet`` — serve prompts through a replicated
+fleet.
+
+Spawns ``--replicas`` child serving processes (identical params: same
+seeded init or the same ``--ckpt-dir``), routes prompts through the
+least-loaded dispatcher, supervises heartbeats in the background, and
+drains the whole fleet on exit (or SIGTERM). With ``--session-dir`` the
+replicas share one durable session store, so conversations survive both
+replica drains and whole-fleet restarts — and a ``--session-id`` turn may
+be served by a different replica each invocation.
+
+``--local`` runs the replicas as in-process threads instead of child
+processes: same router/supervisor wiring, no spawn cost — the debugging
+and CI transport.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from orion_tpu.fleet.replica import (
+    LocalReplica,
+    ProcessReplica,
+    ReplicaSpec,
+    build_model,
+    serve_config,
+)
+from orion_tpu.fleet.supervisor import Supervisor
+from orion_tpu.serving.server import OverloadError, RejectedError
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("orion_tpu.fleet")
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--replicas", type=int, default=2,
+                   help="engine replicas behind the router (child serving "
+                        "processes; --local makes them threads)")
+    p.add_argument("--local", action="store_true",
+                   help="thread-backed replicas in this process instead of "
+                        "child OS processes (debugging / CI)")
+    p.add_argument("--max-inflight", type=int, default=0,
+                   help="FLEET-level admission bound across all replicas "
+                        "(0 = per-replica bounds only); beyond it submits "
+                        "shed with OverloadError, the single-server "
+                        "contract one level up")
+    p.add_argument("--session-dir", default=None,
+                   help="SHARED durable-session store: any replica resumes "
+                        "any conversation from disk (migration is a read)")
+    p.add_argument("--session-id", default=None,
+                   help="tag prompts as conversation turns (line i gets "
+                        "'<id>-<i>' when several prompts are given)")
+    p.add_argument("--prompts-file", default="-",
+                   help="one prompt per line; '-' = stdin")
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    # pass-through engine knobs (per replica)
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--chunk", type=int, default=16)
+    p.add_argument("--prefill-chunk", type=int, default=64)
+    p.add_argument("--prefill-buckets", default="pow2")
+    p.add_argument("--replica-max-inflight", type=int, default=8,
+                   help="per-replica admission queue bound")
+    p.add_argument("--pin-cores", action="store_true",
+                   help="pin each replica's XLA compute pool to one core "
+                        "(rotating by replica index) — without it one "
+                        "replica's pool spans every CPU and N replicas "
+                        "fight for the same cores instead of scaling")
+    p.add_argument("--deadline-ms", type=float, default=0.0)
+    p.add_argument("--heartbeat-s", type=float, default=1.0,
+                   help="supervisor heartbeat interval")
+    p.add_argument("--grace", type=float, default=30.0)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="ModelConfig override (must match the checkpoint)")
+    return p
+
+
+def _spec_from_args(args) -> ReplicaSpec:
+    overrides = {}
+    if args.set:
+        from orion_tpu.utils.config import parse_set_overrides
+
+        overrides = parse_set_overrides(args.set)
+    return ReplicaSpec(
+        config=args.config,
+        overrides=overrides or None,
+        ckpt_dir=args.ckpt_dir,
+        serve={
+            "slots": args.slots,
+            "chunk": args.chunk,
+            "prefill_chunk": args.prefill_chunk,
+            "prefill_buckets": args.prefill_buckets,
+            "max_inflight": args.replica_max_inflight,
+            "deadline_ms": args.deadline_ms,
+            "grace": args.grace,
+            "session_dir": args.session_dir,
+        },
+    )
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    if args.session_id and not args.session_dir:
+        print("--session-id requires --session-dir", file=sys.stderr)
+        return 2
+    spec = _spec_from_args(args)
+
+    if args.local:
+        model, params = build_model(spec)
+
+        def factory(name: str):
+            return LocalReplica(
+                model, params, serve_config(spec), name=name
+            ).start()
+    else:
+        import dataclasses
+        import os
+
+        def factory(name: str):
+            s = spec
+            if args.pin_cores:
+                idx = Supervisor.replica_index(name)
+                s = dataclasses.replace(
+                    spec, compute_cpus=[idx % (os.cpu_count() or 1)]
+                )
+            return ProcessReplica(s, name=name).start()
+
+    from orion_tpu.generate import SampleConfig
+    from orion_tpu.utils.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    sample = SampleConfig(args.temperature, args.top_k, args.top_p)
+
+    if args.prompts_file == "-":
+        lines = [ln.rstrip("\n") for ln in sys.stdin]
+    else:
+        with open(args.prompts_file) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+    if args.session_id:
+        lines = lines or [""]
+    else:
+        lines = [ln for ln in lines if ln]
+
+    sup = Supervisor(
+        factory, args.replicas, max_inflight=args.max_inflight,
+    ).start()
+    sup.start_monitor(interval=args.heartbeat_s)
+    rc = 0
+    completed = []
+    try:
+        import numpy as np
+
+        from orion_tpu.serving.session import DecodeRequest
+
+        for i, line in enumerate(lines):
+            sid = None
+            if args.session_id:
+                sid = (args.session_id if len(lines) == 1
+                       else f"{args.session_id}-{i}")
+            req = DecodeRequest(
+                prompt=np.asarray([tok.encode(line)], np.int32).reshape(1, -1),
+                max_new_tokens=args.max_new_tokens,
+                sample=sample, seed=args.seed + i, session_id=sid,
+            )
+            while True:
+                try:
+                    completed.append((line, sup.router.submit(req)))
+                    break
+                except OverloadError:
+                    # wave-drain like the single-server CLI: wait for the
+                    # oldest outstanding result, then resubmit
+                    for _, p in completed:
+                        if not p.done.is_set():
+                            p.done.wait(timeout=60.0)
+                            break
+                except RejectedError as e:
+                    print(f"rejected: {e}", file=sys.stderr)
+                    rc = 1
+                    break
+            if rc:
+                break
+        for line, pending in completed:
+            if pending.done.wait(timeout=600.0):
+                continue
+            print(f"[dropped] {line}", file=sys.stderr)
+        for line, pending in completed:
+            if pending.error is not None:
+                print(f"[{type(pending.error).__name__}] {line}",
+                      file=sys.stderr)
+                continue
+            r = pending.result
+            if r is None:
+                continue
+            ids = [int(t) for t in r.tokens[0]]
+            tag = "" if r.status == "ok" else f" [{r.status}]"
+            print(line + tok.decode(ids) + tag)
+        snap = sup.router.snapshot()
+        print(f"fleet: {snap}", file=sys.stderr)
+    finally:
+        sup.drain_all(timeout=args.grace * 2)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

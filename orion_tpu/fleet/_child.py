@@ -1,0 +1,12 @@
+"""Child-process entry point for :class:`orion_tpu.fleet.ProcessReplica`.
+
+A separate module (not ``replica`` itself) so ``python -m
+orion_tpu.fleet._child`` doesn't re-execute a module the package
+``__init__`` already imported (runpy's double-import warning)."""
+
+import sys
+
+if __name__ == "__main__":
+    from orion_tpu.fleet.replica import _child_main
+
+    sys.exit(_child_main())

@@ -1,0 +1,256 @@
+"""Admission-aware least-loaded dispatch over N replicas.
+
+The router is the fleet's front door: one ``submit()`` that places a
+request on the best live replica and returns that replica's pending
+handle. "Best" is deliberately simple — the O(1)-state engine makes every
+replica equally able to serve every request (sessions live on shared
+disk, migration is a read), so placement is pure load balancing:
+
+- **least-loaded** — candidates sort by (health rank, in-flight count,
+  index): SERVING/STARTING replicas before DEGRADED ones (a limping
+  replica still serves correctly, PR 4's ladder contract, but it only
+  gets work when every healthy peer is busier), DRAINING/DEAD replicas
+  are never candidates. In-flight counts are router-side (incremented at
+  dispatch, decremented at result) so dispatch needs no status round-trip
+  on the hot path.
+- **bounded fleet admission** — ``max_inflight`` bounds the TOTAL
+  in-flight work across the fleet; beyond it ``submit`` sheds with
+  :class:`~orion_tpu.serving.server.OverloadError` — the same contract
+  the single server has had since PR 4, one level up. Per-replica sheds
+  (a full admission queue) fail over to the next candidate; only a fleet
+  with nowhere left to put the request raises.
+- **failover** — a dispatch that dies on the wire (control channel broke,
+  replica just exited, an injected ``fleet.dispatch``/``fleet.control_io``
+  fault) moves to the next candidate; the request only fails when every
+  routable replica refused. The supervisor notices the broken replica on
+  its next heartbeat and respawns it — the router never blocks on that.
+- **session serialization** — one turn at a time per conversation,
+  FLEET-wide: the router remembers the pending of each session's last
+  turn and refuses a new one until it resolved. (Per-replica servers
+  enforce this locally; with shared-store mobility the fleet needs the
+  same fence globally, or two replicas could both resume generation N.)
+
+``fire("fleet.dispatch", step=ordinal)`` runs before each placement
+attempt — the chaos address for dispatch-path faults.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Tuple
+
+from orion_tpu.resilience.inject import fire
+from orion_tpu.serving.server import OverloadError, RejectedError
+from orion_tpu.serving.session import DecodeRequest
+
+from orion_tpu.fleet.replica import FleetPending, ReplicaGone, ReplicaHandle
+
+_HEALTH_RANK = {"starting": 0, "serving": 0, "degraded": 1}
+
+
+class Router:
+    """Thread-safe dispatcher over a (mutable) replica list. The
+    supervisor owns the list and swaps respawned replicas in under
+    :meth:`replace`; submitters may call :meth:`submit` from any thread."""
+
+    def __init__(
+        self,
+        replicas: List[ReplicaHandle],
+        max_inflight: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.replicas = list(replicas)
+        self.max_inflight = int(max_inflight)  # 0 = unbounded fleet queue
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._active_sessions: Dict[str, object] = {}  # sid -> pending
+        self._dispatches = 0  # fleet.dispatch's step address
+        self._dispatching = 0  # submits between admission check and wire ack
+        self.stats: Dict[str, int] = {
+            "dispatched": 0, "shed": 0, "rejected": 0, "failovers": 0,
+        }
+
+    # -- replica-set maintenance ----------------------------------------------
+
+    def replace(self, old: ReplicaHandle, new: ReplicaHandle) -> None:
+        with self._lock:
+            for i, r in enumerate(self.replicas):
+                if r is old:
+                    self.replicas[i] = new
+                    return
+            self.replicas.append(new)
+
+    def _candidates(self) -> List[Tuple[int, int, int, ReplicaHandle]]:
+        """Routable replicas, best-first: (health rank, inflight, index).
+        DRAINING/DEAD/dead-process replicas never appear."""
+        out = []
+        for i, r in enumerate(self.replicas):
+            if not r.routable:
+                continue
+            rank = _HEALTH_RANK.get(r.health_state())
+            if rank is None:
+                continue
+            out.append((rank, r.inflight, i, r))
+        out.sort(key=lambda t: t[:3])
+        return out
+
+    # -- dispatch -------------------------------------------------------------
+
+    def submit(self, request: DecodeRequest):
+        """Place ``request`` on the least-loaded routable replica and
+        return its pending handle. Raises OverloadError when the fleet's
+        admission bound is hit (or every replica shed), RejectedError
+        when no replica is routable at all, ValueError for a busy
+        session — always loudly, never a silent drop.
+
+        The router lock covers only the BOOKKEEPING (session fence,
+        admission count, candidate pick) — never the wire round-trip to
+        a replica, which can block for seconds on a wedged child. One
+        slow replica must not stall every other submitter, the gauges,
+        or the supervisor's healing path. The session fence therefore
+        RESERVES the conversation under the lock before dispatching
+        (a placeholder pending other submitters see as in-flight) and
+        swaps the real pending in — or releases the reservation — once
+        the wire settles."""
+        sid = request.session_id
+        reservation = None
+        with self._lock:
+            if self._dispatches % 256 == 0:
+                # amortized sweep: a conversation that never returns
+                # must not pin its last pending (and result tokens)
+                # in the session fence forever
+                self._active_sessions = {
+                    s: p for s, p in self._active_sessions.items()
+                    if not p.done.is_set()
+                }
+            if sid is not None:
+                prev = self._active_sessions.get(sid)
+                if prev is not None and not prev.done.is_set():
+                    raise ValueError(
+                        f"session {sid!r} already has a turn in flight on "
+                        "this fleet; one turn at a time per conversation"
+                    )
+            if self.max_inflight > 0:
+                total = (
+                    sum(r.inflight for r in self.replicas if r.alive)
+                    + self._dispatching
+                )
+                if total >= self.max_inflight:
+                    self.stats["shed"] += 1
+                    raise OverloadError(
+                        f"fleet admission full ({total} in flight >= "
+                        f"max_inflight {self.max_inflight})"
+                    )
+            candidates = self._candidates()
+            if not candidates:
+                self.stats["rejected"] += 1
+                raise RejectedError("no routable replica in the fleet")
+            self._dispatching += 1
+            if sid is not None:
+                reservation = FleetPending(
+                    session_id=sid, done=threading.Event()
+                )
+                self._active_sessions[sid] = reservation
+        failures = []
+        overloads = 0
+        owed = True  # does _dispatching still carry this request?
+        try:
+            for _, _, _, replica in candidates:
+                with self._lock:
+                    self._dispatches += 1
+                    step = self._dispatches
+                try:
+                    fire("fleet.dispatch", step=step)
+                    # hand the admission count over to the replica's own
+                    # inflight gauge (incremented at submit entry):
+                    # keeping _dispatching elevated too would DOUBLE-
+                    # count this request against max_inflight for the
+                    # whole ack round-trip and shed below capacity
+                    with self._lock:
+                        self._dispatching -= 1
+                    owed = False
+                    try:
+                        pending = replica.submit(request)
+                    except BaseException:
+                        with self._lock:
+                            self._dispatching += 1
+                        owed = True
+                        raise
+                except OverloadError as e:
+                    overloads += 1
+                    failures.append((replica.name, e))
+                    continue
+                except (ReplicaGone, OSError, RejectedError) as e:
+                    # wire-level failure, or the replica started draining
+                    # between the routable check and the submit: fail
+                    # over, let the supervisor's heartbeat find the corpse
+                    with self._lock:
+                        self.stats["failovers"] += 1
+                    failures.append((replica.name, e))
+                    continue
+                with self._lock:
+                    self.stats["dispatched"] += 1
+                    if sid is not None:
+                        self._active_sessions[sid] = pending
+                        reservation = None
+                return pending
+            with self._lock:
+                if overloads:
+                    # ANY replica merely shedding means capacity exists
+                    # and will free up — classify the round as overload
+                    # (retryable), never as a permanent-looking reject
+                    self.stats["shed"] += 1
+                    raise OverloadError(
+                        ("every routable replica shed the request: "
+                         if overloads == len(failures)
+                         else "no capacity on any routable replica: ")
+                        + "; ".join(f"{n}: {e}" for n, e in failures)
+                    )
+                self.stats["rejected"] += 1
+            raise RejectedError(
+                "dispatch failed on every routable replica: "
+                + "; ".join(f"{n}: {type(e).__name__}" for n, e in failures)
+            )
+        finally:
+            with self._lock:
+                if owed:
+                    self._dispatching -= 1
+                if reservation is not None and (
+                    self._active_sessions.get(sid) is reservation
+                ):
+                    del self._active_sessions[sid]
+
+    # -- observability --------------------------------------------------------
+
+    def inflight(self) -> int:
+        with self._lock:
+            return sum(r.inflight for r in self.replicas if r.alive)
+
+    def snapshot(self) -> dict:
+        """Fleet-level gauge payload: per-replica liveness/health/load
+        plus the router's own counters."""
+        with self._lock:
+            return {
+                "replicas": [
+                    {
+                        "name": r.name,
+                        "alive": r.alive,
+                        "state": r.health_state(),
+                        "inflight": r.inflight,
+                    }
+                    for r in self.replicas
+                ],
+                "inflight": sum(
+                    r.inflight for r in self.replicas if r.alive
+                ),
+                "max_inflight": self.max_inflight,
+                "active_sessions": sum(
+                    1 for p in self._active_sessions.values()
+                    if not p.done.is_set()
+                ),
+                "stats": dict(self.stats),
+            }
+
+
+__all__ = ["Router"]

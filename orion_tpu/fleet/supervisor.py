@@ -1,0 +1,232 @@
+"""Drain-and-respawn supervision for a replica fleet.
+
+The supervisor owns the replica set behind the router and enforces one
+invariant: the fleet's serving capacity heals itself without losing a
+conversation. Its loop is a plain poll (``tick``), so tests drive it
+deterministically and production runs it on a thread:
+
+- **heartbeat** — every tick polls each replica's ``status()`` (the
+  server's atomic health+occupancy snapshot) with a timeout. A replica
+  that misses ``miss_limit`` consecutive polls is presumed wedged: it is
+  killed and respawned. Any committed session generations it held are on
+  the SHARED store, so its conversations resume elsewhere.
+- **degraded ⇒ drain-and-respawn** — a replica reporting DEGRADED (its
+  ladder engaged, a watchdog tripped, a save failed) is SIGTERM-drained:
+  in-flight sessionless work completes, resident sessions SUSPEND to the
+  shared store (one O(1) snapshot each), the process exits 0 — then a
+  fresh replica takes its slot in the router. In-flight conversations
+  continue on the survivors with zero lost turns; nobody waits for the
+  limping replica to limp through its backlog.
+- **exit ⇒ respawn** — a replica that simply died (OOM-killed, crashed)
+  is replaced; the router's failover already stopped sending it work the
+  moment its channel broke.
+- **spawn retries** — replica creation runs under the resilience retry
+  layer with the ``fleet.replica_spawn`` hook inside the retried region,
+  so a transient spawn failure (fork pressure, a slow filesystem) is a
+  backoff, not a capacity loss.
+
+Draining the LAST healthy replica is still correct — the router rejects
+while nothing is routable and heals when the respawn reports ready — but
+the supervisor replaces replicas one at a time precisely so that window
+stays one replica wide.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+from orion_tpu.resilience.inject import fire
+from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
+
+from orion_tpu.fleet.replica import ReplicaHandle
+from orion_tpu.fleet.router import Router
+
+
+class Supervisor:
+    """Spawns ``n`` replicas via ``factory(name)`` (must return a STARTED
+    handle), builds the router over them, and heals the set on
+    :meth:`tick` (or the :meth:`start_monitor` thread)."""
+
+    def __init__(
+        self,
+        factory: Callable[[str], ReplicaHandle],
+        n: int,
+        *,
+        max_inflight: int = 0,
+        heartbeat_timeout: float = 5.0,
+        miss_limit: int = 3,
+        drain_grace: float = 30.0,
+        ready_timeout: float = 240.0,
+        spawn_retry: Optional[RetryPolicy] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        assert n >= 1, n
+        self.factory = factory
+        self.n = int(n)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.miss_limit = int(miss_limit)
+        self.drain_grace = float(drain_grace)
+        self.ready_timeout = float(ready_timeout)
+        self.spawn_retry = (
+            spawn_retry if spawn_retry is not None else RetryPolicy(attempts=3)
+        )
+        self._clock = clock
+        self._max_inflight = int(max_inflight)
+        self._spawn_count = 0  # fleet.replica_spawn's step address
+        self._misses: dict = {}
+        self.replicas: List[ReplicaHandle] = []
+        self.router: Optional[Router] = None
+        self.events: List[tuple] = []  # (t, replica name, what) audit log
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "Supervisor":
+        self.replicas = [self._spawn(i) for i in range(self.n)]
+        self.router = Router(
+            self.replicas, max_inflight=self._max_inflight, clock=self._clock
+        )
+        # the router holds the SAME list object; replacements mutate it
+        self.replicas = self.router.replicas
+        return self
+
+    @staticmethod
+    def replica_index(name: str) -> int:
+        """The replica SLOT index encoded in a factory name
+        (``replica-{idx}.g{spawn}``) — stable across respawns, so
+        factories can key per-slot resources (e.g. a pinned compute
+        core) off it without re-parsing the format themselves."""
+        return int(name.split("-")[1].split(".")[0])
+
+    def _spawn(self, idx: int) -> ReplicaHandle:
+        def make() -> ReplicaHandle:
+            self._spawn_count += 1
+            fire("fleet.replica_spawn", step=self._spawn_count)
+            replica = self.factory(f"replica-{idx}.g{self._spawn_count}")
+            try:
+                replica.wait_ready(self.ready_timeout)
+            except Exception:
+                replica.kill()
+                replica.join(timeout=10.0)
+                raise
+            return replica
+
+        replica = call_with_retries(
+            make, self.spawn_retry, describe=f"replica {idx} spawn"
+        )
+        self._event(replica.name, "spawned")
+        return replica
+
+    def _event(self, name: str, what: str) -> None:
+        self.events.append((self._clock(), name, what))
+        print(f"[fleet] {name}: {what}", file=sys.stderr)
+
+    # -- healing --------------------------------------------------------------
+
+    def tick(self) -> None:
+        """One supervision pass over every replica. Safe to call from a
+        monitor thread or directly from a test."""
+        for idx, replica in enumerate(list(self.replicas)):
+            if replica is not self.replicas[idx]:
+                continue  # replaced mid-iteration
+            if not replica.alive:
+                self._event(replica.name, "exited; respawning")
+                replica.join(timeout=1.0)
+                self._replace(idx, replica)
+                continue
+            status = replica.status(timeout=self.heartbeat_timeout)
+            if status is None:
+                misses = self._misses.get(replica.name, 0) + 1
+                self._misses[replica.name] = misses
+                self._event(
+                    replica.name, f"heartbeat missed ({misses}/{self.miss_limit})"
+                )
+                if misses >= self.miss_limit:
+                    self._event(replica.name, "presumed wedged; killing")
+                    replica.kill()
+                    replica.join(timeout=10.0)
+                    self._replace(idx, replica)
+                continue
+            self._misses[replica.name] = 0
+            state = status.get("state")
+            if state == "degraded":
+                self._drain_respawn(idx, replica, "degraded")
+            elif state == "dead":
+                self._event(replica.name, "reports dead; respawning")
+                replica.join(timeout=1.0)
+                self._replace(idx, replica)
+
+    def _drain_respawn(self, idx: int, replica: ReplicaHandle,
+                       why: str) -> None:
+        """SIGTERM-drain ``replica`` (its sessions suspend to the shared
+        store), wait out the grace, escalate to kill, respawn fresh."""
+        self._event(replica.name, f"{why}; draining")
+        replica.drain()
+        if not replica.join(timeout=self.drain_grace):
+            self._event(replica.name, "drain overran grace; killing")
+            replica.kill()
+            replica.join(timeout=10.0)
+        self._replace(idx, replica)
+
+    def _replace(self, idx: int, old: ReplicaHandle) -> None:
+        self._misses.pop(old.name, None)
+        new = self._spawn(idx)
+        # only reachable via tick()/_drain_respawn(), i.e. after start()
+        # built the router (the replicas list IS the router's list)
+        assert self.router is not None
+        self.router.replace(old, new)
+
+    # -- monitor thread -------------------------------------------------------
+
+    def start_monitor(self, interval: float = 1.0) -> None:
+        assert self._monitor is None, "monitor already running"
+        self._stop.clear()
+
+        def run() -> None:
+            while not self._stop.wait(timeout=interval):
+                try:
+                    self.tick()
+                except Exception as e:  # supervision must outlive one bad tick
+                    print(f"[fleet] tick failed: {type(e).__name__}: {e}",
+                          file=sys.stderr)
+
+        self._monitor = threading.Thread(
+            target=run, name="fleet-monitor", daemon=True
+        )
+        self._monitor.start()
+
+    def stop_monitor(self) -> None:
+        if self._monitor is None:
+            return
+        self._stop.set()
+        self._monitor.join(timeout=10.0)
+        self._monitor = None
+
+    # -- shutdown -------------------------------------------------------------
+
+    def drain_all(self, timeout: float = 60.0) -> None:
+        """Graceful fleet shutdown: drain every replica concurrently,
+        escalate stragglers to kill after ``timeout``."""
+        self.stop_monitor()
+        for replica in self.replicas:
+            replica.drain()
+        deadline = self._clock() + timeout
+        for replica in self.replicas:
+            left = max(deadline - self._clock(), 0.1)
+            if not replica.join(timeout=left):
+                self._event(replica.name, "drain timeout; killing")
+                replica.kill()
+                replica.join(timeout=10.0)
+
+    def kill_all(self) -> None:
+        self.stop_monitor()
+        for replica in self.replicas:
+            replica.kill()
+            replica.join(timeout=10.0)
+
+
+__all__ = ["Supervisor"]

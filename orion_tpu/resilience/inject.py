@@ -45,6 +45,15 @@ Hook sites wired today:
 ``"serve.session_load"``  serving/session_store.py SessionStore.load, inside
                           the retried read of one session generation
                           (step = the generation number)
+``"fleet.dispatch"``      fleet/router.py Router.submit, before each
+                          replica-placement attempt (step = the fleet-wide
+                          dispatch ordinal) — an injected fault here fails
+                          over to the next candidate replica
+``"fleet.replica_spawn"`` fleet/supervisor.py replica spawn, inside the
+                          retry region (step = the spawn ordinal)
+``"fleet.control_io"``    fleet/replica.py ProcessReplica control-channel
+                          writes (parent side) — an injected OSError models
+                          a broken pipe to a dead child
 ========================  ====================================================
 
 Every wired site is REGISTERED in :data:`SITES` (dynamic per-slot sites by
@@ -90,6 +99,12 @@ SITES = {
     "decode.state_nan": "DecodeSession decode-state poisoning marker",
     "serve.session_save": "serving/session_store.py save, inside retry",
     "serve.session_load": "serving/session_store.py load, inside retry",
+    "fleet.dispatch": "fleet/router.py submit, before each placement "
+                      "attempt (step = fleet-wide dispatch ordinal)",
+    "fleet.replica_spawn": "fleet/supervisor.py _spawn, inside the spawn "
+                           "retry region (step = spawn ordinal)",
+    "fleet.control_io": "fleet/replica.py control-channel write (parent "
+                        "side), before the pipe I/O",
 }
 # dynamically-addressed site families (matched by prefix)
 SITE_PREFIXES = ("decode.slot_nan.",)

@@ -71,11 +71,18 @@ class HealthMachine:
         clock: Callable[[], float] = time.monotonic,
         on_transition: Optional[Callable[[Health, Health, str], None]] = None,
         history_limit: int = HISTORY_LIMIT,
+        lock=None,
     ):
         assert history_limit >= 1, history_limit
         self._clock = clock
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        # ``lock``: an externally-owned RLock shared with the caller's
+        # other gauges. The Server passes its stats lock so a fleet
+        # router's ``Server.snapshot()`` reads health + occupancy as ONE
+        # atomic pair — no transition can interleave between the two
+        # reads and hand the router a torn (health, slots) view. Must be
+        # reentrant when shared (the snapshot caller holds it already).
+        self._lock = lock if lock is not None else threading.Lock()
         self._state = Health.STARTING
         self._since = clock()
         self._history_limit = int(history_limit)

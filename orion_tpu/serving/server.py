@@ -174,7 +174,13 @@ class Server:
             prefill_chunk=cfg.prefill_chunk,
             prompt_overflow=cfg.prompt_overflow,
         )
-        self.health = HealthMachine(clock=clock)
+        # ONE reentrant lock guards the stats dict AND the health machine:
+        # `snapshot()` reads both under a single acquisition, so a fleet
+        # router polling /healthz can never observe a torn pair (e.g. the
+        # old health state with the new slot gauges). Reentrant because
+        # snapshot() holds it while calling health.snapshot().
+        self._stats_lock = threading.RLock()
+        self.health = HealthMachine(clock=clock, lock=self._stats_lock)
         # durable sessions: write-through disk store + a host-resident LRU
         # cache in front of it (resident entries are ALWAYS also on disk,
         # so idle/LRU eviction is pure cache management, and the race
@@ -204,8 +210,6 @@ class Server:
         # put landing between the serve loop's last empty-check and DEAD
         # would strand a Pending whose done event never fires.
         self._admission_lock = threading.Lock()
-        # ...and the dict read-modify-writes below race without their own
-        self._stats_lock = threading.Lock()
         self.stats: Dict[str, int] = {
             "admitted": 0, "shed": 0, "rejected": 0,
             "ok": 0, "deadline": 0, "failed": 0,
@@ -482,11 +486,25 @@ class Server:
     def _session_lookup(self, sid: str) -> Optional[SessionState]:
         """Resident cache first (popped while active), then the newest
         intact on-disk generation (corrupt latest falls back inside the
-        store; all-corrupt raises — isolated to this request)."""
+        store; all-corrupt raises — isolated to this request).
+
+        The resident copy is only trusted when it is still the newest
+        COMMITTED generation on disk: in a fleet, every replica shares
+        one session_dir and a later turn may have landed on a different
+        replica — its save makes this replica's cached copy stale, and
+        resuming from it would silently fork the conversation. The
+        generation check is one directory listing; a DIRTY copy (its
+        save failed, so it is newer than anything on disk) stays
+        authoritative — the single-writer-per-turn contract the router
+        enforces means nobody else could have advanced it."""
         sess = self._sessions.pop(sid, None)
         if sess is not None:
             self._session_last_use.pop(sid, None)
-            return sess
+            if (self.session_store is None or sid in self._dirty_sessions
+                    or sess.generation
+                    >= self.session_store.newest_generation(sid)):
+                return sess
+            # stale: another replica advanced the conversation on disk
         if self.session_store is None:
             return None
         return self.session_store.load(sid)
@@ -615,16 +633,23 @@ class Server:
             return self.stats["slot_steps_active"] / total if total else 0.0
 
     def snapshot(self) -> dict:
-        """Health + scheduler gauges in one payload (the /healthz body)."""
-        snap = self.health.snapshot()
+        """Health + scheduler gauges in one payload (the /healthz body).
+
+        ONE lock acquisition covers the whole read — the health machine
+        shares the server's stats lock, so the health state, the stats
+        dict, and the prefilling/decoding slot counts are a consistent
+        instant: a fleet router acting on this payload never routes on a
+        torn (health, occupancy) pair."""
         with self._stats_lock:
+            snap = self.health.snapshot()
             snap["stats"] = dict(self.stats)
-        snap["occupancy"] = self.occupancy()
-        snap["slots"] = self.engine.occupancy()
-        snap["sessions"] = {
-            "resident": len(self._sessions),
-            "in_slots": len(self._active_sessions),
-        }
+            snap["occupancy"] = self.occupancy()  # RLock: nested is fine
+            snap["slots"] = self.engine.occupancy()
+            snap["sessions"] = {
+                "resident": len(self._sessions),
+                "in_slots": len(self._active_sessions),
+            }
+            snap["queued"] = self._q.qsize()
         return snap
 
     def _maybe_drain(self, guard) -> None:

@@ -217,6 +217,13 @@ class SessionStore:
                     continue
         return sorted(out)
 
+    def newest_generation(self, session_id: str) -> int:
+        """Newest committed generation number (0 = never saved) — the
+        cheap staleness check a shared-store fleet replica runs before
+        trusting its resident cached copy of a session."""
+        gens = self.generations(session_id)
+        return gens[-1] if gens else 0
+
     def list_sessions(self) -> List[str]:
         return sorted(
             n for n in os.listdir(self.directory)

@@ -129,6 +129,11 @@ _SLOW = {
     "test_fused_ce.py::test_model_token_losses_padded_path_parity",
     "test_quant.py::test_quant_moe_forward_close",
     "test_training.py::test_overfit_fixed_batch",
+    # fleet process-replica tests: each spawns real child serving
+    # processes (jax import + model build per child, ~15-40s each)
+    "test_fleet.py::test_process_fleet_drain_reroute_bitwise[greedy]",
+    "test_fleet.py::test_process_fleet_drain_reroute_bitwise[sampled]",
+    "test_fleet.py::test_process_fleet_kill_control_io_and_heartbeat",
 }
 
 

@@ -422,6 +422,50 @@ def test_every_registered_rule_has_a_fixture():
     assert len(ALL_RULES) >= 8
 
 
+def test_unbounded_wait_fleet_scope_widens_to_wait_and_recv():
+    """In orion_tpu/fleet/ the peer of a wait is a child OS process, so
+    no-timeout ``.wait()``/``.recv()`` are findings there — and only
+    there (elsewhere those names are too ambiguous to flag)."""
+    bad = """
+def reap(proc, conn, ev):
+    proc.wait()
+    msg = conn.recv()
+    ev.wait()
+    return msg
+"""
+    clean = """
+def reap(proc, conn, ev):
+    proc.wait(timeout=10.0)
+    conn.settimeout(2.0)
+    msg = conn.recv(4096)     # sized read on a timeout'd socket
+    ev.wait(timeout=1.0)
+    return msg
+"""
+    assert "unbounded-wait" in rule_ids(
+        lint_source(bad, path="orion_tpu/fleet/replica_dummy.py")
+    )
+    assert "unbounded-wait" not in rule_ids(
+        lint_source(clean, path="orion_tpu/fleet/replica_dummy.py")
+    )
+    # outside fleet/ the widened methods stay un-flagged...
+    assert "unbounded-wait" not in rule_ids(
+        lint_source(bad, path="orion_tpu/training/dummy.py")
+    )
+    # ...while the classic get/join findings still fire in fleet/ too
+    classic = """
+import queue
+
+_q = queue.Queue()
+
+def pump(worker):
+    worker.join()
+    return _q.get()
+"""
+    assert "unbounded-wait" in rule_ids(
+        lint_source(classic, path="orion_tpu/fleet/router_dummy.py")
+    )
+
+
 def test_unbounded_wait_exempts_tests():
     src = """
 import queue
