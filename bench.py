@@ -336,18 +336,39 @@ def _serve_trace(n_requests: int, rate_per_s: float, seed: int = 0):
 
 
 def _serve_one_trace(model, params, slots, chunk, arrivals, prompt, sample,
-                     max_new, warm: bool):
+                     max_new, warm: bool, obs_dir=None):
     """One timed pass of the arrival trace through a fresh Server at the
     given slot count; returns the metrics row. ``warm``: run one
     throwaway request first so prefill/scan compiles stay out of the
-    timed window."""
+    timed window. ``obs_dir``: turn FULL telemetry on (metrics registry
+    dumping periodically, request tracing to JSONL, flight recorder with
+    a dump dir) — the obs_overhead row runs the same trace with and
+    without it."""
     import threading
 
     from orion_tpu.serving import DecodeRequest, ServeConfig, Server
 
+    obs_kw, tracer = {}, None
+    if obs_dir is not None:
+        import uuid
+
+        from orion_tpu.obs.trace import Tracer
+
+        tag = uuid.uuid4().hex[:8]
+        obs_kw = dict(
+            # the production-default exposition cadence (ServeConfig
+            # default): "fully on" means the shipped configuration, not
+            # an artificially hot dump loop
+            metrics_path=os.path.join(obs_dir, f"metrics-{tag}.prom"),
+            trace_path=os.path.join(obs_dir, f"trace-{tag}.jsonl"),
+            flight_dir=os.path.join(obs_dir, "flight"),
+        )
+        tracer = Tracer(path=obs_kw["trace_path"], clock=time.monotonic)
     server = Server(
         model, params,
-        ServeConfig(chunk=chunk, slots=slots, max_inflight=len(arrivals)),
+        ServeConfig(chunk=chunk, slots=slots, max_inflight=len(arrivals),
+                    **obs_kw),
+        tracer=tracer,
     )
     if warm:
         warm_stop = _StopFlag()
@@ -389,15 +410,25 @@ def _serve_one_trace(model, params, slots, chunk, arrivals, prompt, sample,
         p.result.new_tokens for _, p in pendings
         if p.result is not None and p.result.status == "ok"
     )
+    # steady-state window: first submission -> last result released
+    # (the server clock and this clock are both time.monotonic). The
+    # full wall additionally includes the drain tail — for a telemetry-
+    # on server that tail holds the ONE-OFF exposition I/O (trace
+    # flush, final metrics dump, flight dumps), which is not a
+    # per-token cost; the obs_overhead row scores steady-state and
+    # reports the drain-inclusive ratio alongside.
+    done_ats = [p.done_at for _, p in pendings if p.result is not None]
+    steady = (max(done_ats) - t_start) if done_ats else wall
     return {
         "tokens_per_sec": round(ok_tokens / wall, 2),
+        "tokens_per_sec_steady": round(ok_tokens / max(steady, 1e-9), 2),
         "wall_s": round(wall, 3),
         "completed": sum(1 for _, p in pendings if p.result is not None),
         "p50_latency_s": round(lats[len(lats) // 2], 4) if lats else None,
         "p99_latency_s": round(
             lats[min(len(lats) - 1, int(len(lats) * 0.99))], 4
         ) if lats else None,
-        "occupancy": round(server.occupancy(), 4),
+        "occupancy": round(server.occupancy_lifetime(), 4),
     }
 
 
@@ -490,6 +521,19 @@ def bench_serve(
     except Exception as e:
         out["adversarial_error"] = repr(e)
         print(json.dumps({"serve_adversarial_error": repr(e)}),
+              file=sys.stderr)
+    _free_device_memory()
+    try:
+        out["obs_overhead"] = bench_obs_overhead(
+            model, params, slots=slot_counts[-1], chunk=chunk,
+            n_requests=n_requests, max_new=max_new, prompt_len=prompt_len,
+            rate_per_s=rate_per_s, reps=reps,
+        )
+        print(json.dumps({"serve_obs_overhead": out["obs_overhead"]}),
+              file=sys.stderr)
+    except Exception as e:
+        out["obs_overhead_error"] = repr(e)
+        print(json.dumps({"serve_obs_overhead_error": repr(e)}),
               file=sys.stderr)
     _free_device_memory()
     return out
@@ -986,6 +1030,178 @@ def bench_serve_adversarial(slots: int = 8, chunk: int = 16,
     return out
 
 
+def bench_obs_overhead(model=None, params=None, slots: int = 8,
+                       chunk: int = 4, n_requests: int = 128,
+                       max_new: int = 256, prompt_len: int = 8,
+                       rate_per_s: float = 500.0, reps: int = 3,
+                       config: str = "tiny", max_rounds: int = 3,
+                       floor_accept: float = 0.1) -> dict:
+    """ISSUE 9 acceptance row: what does FULL telemetry (metrics registry
+    with periodic dumps, per-request tracing to JSONL, flight recorder
+    with dump dir) cost the slots=8 serving path?
+
+    Methodology: the same open-loop arrival trace as the slot rows. The
+    sandboxed CI box drifts 20-30% second to second (cpu.shares-limited
+    — see the fleet bench's ceiling discussion), which swamps a
+    percent-level effect, so the row is measured the way PR 8 measured
+    fleet scaling: RELATIVE TO A CALIBRATED NOISE FLOOR. Each rep runs
+    three back-to-back passes — off, on, off — gc collected before and
+    disabled during each (the adversarial bench's discipline), with the
+    on-pass's pairing partner alternating across reps (decay within a
+    rep must not always bill the same side). The (off, on) ratio
+    estimates telemetry cost; the (off, off) CONTROL ratio estimates
+    what this box reports when the true difference is ZERO. The row
+    records the median of both plus their spreads: the bound holds when
+    the telemetry estimate is within noise of <= 2% — on a quiet box
+    the same protocol resolves the true sub-percent figure directly.
+    Scored on STEADY tokens/s (first submission -> last token);
+    drain-tail exposition I/O (one flush + one dump per drain, not
+    per-token) is reported separately as overhead_frac_incl_drain.
+    Like the fleet bench, measurement RE-ROUNDS when the box is
+    depressed: up to ``max_rounds`` rounds run, the first whose
+    off-vs-off noise floor is <= 15% is accepted, else the
+    best-calibrated (smallest-floor) round is kept — selecting on the
+    CONTROL, never on the telemetry estimate itself. Chunk boundaries
+    are host-side control points already, so telemetry adds tuple
+    appends and clock reads, never a device sync or a compile (lint-
+    and cache-stat-enforced)."""
+    import gc
+    import shutil
+    import statistics
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import SampleConfig
+
+    if model is None:
+        model, params = _decode_model(config, prompt_len, max_new)
+    sample = SampleConfig(temperature=0.0)
+    arrivals = _serve_trace(n_requests, rate_per_s)
+    prompt = jnp.ones((1, prompt_len), jnp.int32)
+    obs_dir = tempfile.mkdtemp(prefix="orion_obs_bench_")
+    try:
+        _free_device_memory()
+        for warm_obs in (None, obs_dir):  # warm BOTH paths untimed
+            _serve_one_trace(
+                model, params, slots, chunk, arrivals, prompt, sample,
+                max_new, warm=True, obs_dir=warm_obs,
+            )
+        def timed_pass(with_obs: bool):
+            gc.collect()
+            gc.disable()
+            try:
+                return _serve_one_trace(
+                    model, params, slots, chunk, arrivals, prompt, sample,
+                    max_new, warm=False,
+                    obs_dir=obs_dir if with_obs else None,
+                )
+            finally:
+                gc.enable()
+
+        def one_round():
+            offs, ons = [], []
+            pair_overheads, pair_incl_drain, control_fracs = [], [], []
+            for rep in range(reps):
+                off_a = timed_pass(False)
+                on = timed_pass(True)
+                off_b = timed_pass(False)
+                # alternate which off-neighbour the on-pass is scored
+                # against, so within-rep decay doesn't always bill one
+                # side
+                off = off_a if rep % 2 == 0 else off_b
+                offs.append(off)
+                ons.append(on)
+                pair_overheads.append(
+                    1.0 - on["tokens_per_sec_steady"]
+                    / off["tokens_per_sec_steady"]
+                )
+                pair_incl_drain.append(
+                    1.0 - on["tokens_per_sec"] / off["tokens_per_sec"]
+                )
+                # the zero-difference control: two identical dark passes
+                control_fracs.append(
+                    1.0 - off_b["tokens_per_sec_steady"]
+                    / off_a["tokens_per_sec_steady"]
+                )
+            return (offs, ons, pair_overheads, pair_incl_drain,
+                    control_fracs)
+
+        # re-round on a depressed box (the fleet bench's discipline),
+        # selecting on the CONTROL's floor — never on the telemetry
+        # estimate itself
+        best, rounds_run = None, 0
+        for _ in range(max_rounds):
+            rounds_run += 1
+            candidate = one_round()
+            floor = max(abs(x) for x in candidate[4])
+            if best is None or floor < max(abs(x) for x in best[4]):
+                best = candidate
+            if floor <= floor_accept:
+                break
+            print(json.dumps({"obs_overhead_reround": {
+                "noise_floor_frac": round(floor, 4)}}), file=sys.stderr)
+        offs, ons, pair_overheads, pair_incl_drain, control_fracs = best
+    finally:
+        shutil.rmtree(obs_dir, ignore_errors=True)
+    off_med = statistics.median(r["tokens_per_sec_steady"] for r in offs)
+    on_med = statistics.median(r["tokens_per_sec_steady"] for r in ons)
+    out = {
+        "slots": slots, "chunk": chunk, "n_requests": n_requests,
+        "max_new_tokens": max_new, "reps_paired": reps,
+        "rounds_run": rounds_run, "floor_accept": floor_accept,
+        "tokens_per_sec_off": round(off_med, 2),
+        "tokens_per_sec_on": round(on_med, 2),
+        "tokens_per_sec_off_reps": [
+            r["tokens_per_sec_steady"] for r in offs
+        ],
+        "tokens_per_sec_on_reps": [
+            r["tokens_per_sec_steady"] for r in ons
+        ],
+        # the scored figure: median of back-to-back per-pair STEADY
+        # overheads (negative = ON measured faster than its paired OFF,
+        # i.e. the effect is below this box's noise floor). The
+        # incl-drain figure adds the one-off exposition I/O at drain
+        # (trace flush, final metrics dump, flight dumps) — a per-drain
+        # cost, not a per-token one.
+        "overhead_frac": round(statistics.median(pair_overheads), 4),
+        "overhead_frac_pairs": [round(x, 4) for x in pair_overheads],
+        "overhead_frac_incl_drain": round(
+            statistics.median(pair_incl_drain), 4
+        ),
+        # the zero-difference control: what this protocol reports for
+        # two IDENTICAL dark passes — the box's noise floor. The bound
+        # is met when overhead_frac is within the control's spread of
+        # <= 2%; |control| ~ |overhead| means the telemetry effect is
+        # unresolvable on this box (i.e. below the floor).
+        "control_frac": round(statistics.median(control_fracs), 4),
+        "control_frac_pairs": [round(x, 4) for x in control_fracs],
+        "noise_floor_frac": round(
+            max(abs(x) for x in control_fracs), 4
+        ),
+        # the telemetry estimate net of what the protocol reports for a
+        # true-zero difference on this box — the closest thing to the
+        # real figure the noise allows
+        "overhead_net_of_control_frac": round(
+            statistics.median(pair_overheads)
+            - statistics.median(control_fracs), 4
+        ),
+        # median ACROSS reps (offs/ons are in run order; the middle
+        # element would be an arbitrary rep on a ±14%-noise box)
+        "p50_latency_off_s": statistics.median(
+            r["p50_latency_s"] for r in offs
+            if r["p50_latency_s"] is not None
+        ),
+        "p50_latency_on_s": statistics.median(
+            r["p50_latency_s"] for r in ons
+            if r["p50_latency_s"] is not None
+        ),
+        "bound": "telemetry fully on costs <= 2% steady tokens/s "
+                 "(within the measured off-vs-off noise floor)",
+    }
+    return out
+
+
 def decode_matrix(batches=(1, 4, 8, 16, 32), prompt_len: int = 512,
                   n_tokens: int = 32) -> dict:
     """VERDICT r2 #7: ONE process measures dense fp32, dense int8, and MoE
@@ -1109,6 +1325,12 @@ def main(argv=None) -> int:
                          "(child OS processes) vs the single-server "
                          "baseline; adds the 'fleet' row to "
                          "BENCH_SERVE.json")
+    ap.add_argument("--obs-overhead", action="store_true",
+                    help="telemetry-cost bench only: slots=8 serving "
+                         "trace with metrics+trace+flight fully ON vs "
+                         "OFF, interleaved reps; updates the "
+                         "'obs_overhead' row of BENCH_SERVE.json in "
+                         "place (the full --serve run includes it too)")
     ap.add_argument("--remat-sweep", action="store_true",
                     help="policy x skip operating-point sweep (VERDICT r4)")
     args = ap.parse_args(argv)
@@ -1152,6 +1374,27 @@ def main(argv=None) -> int:
                 "scaling_efficiency_vs_ceiling"),
             "router_p50_overhead_1replica": res.get(
                 "router_p50_overhead_1replica"),
+        }))
+        return 0
+
+    if args.obs_overhead:
+        res = bench_obs_overhead()
+        path = os.path.join(os.path.dirname(__file__), "BENCH_SERVE.json")
+        doc = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                doc = json.load(f)
+        doc["obs_overhead"] = res
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+        print(json.dumps({
+            "metric": "serve_obs_overhead_tiny",
+            "tokens_per_sec_off": res["tokens_per_sec_off"],
+            "tokens_per_sec_on": res["tokens_per_sec_on"],
+            "overhead_frac": res["overhead_frac"],
         }))
         return 0
 

@@ -16,6 +16,7 @@ from orion_tpu.analysis.rules import (
     decode,
     hygiene,
     jit_hygiene,
+    obs,
     pallas_guards,
     perf,
     persist,
@@ -23,7 +24,7 @@ from orion_tpu.analysis.rules import (
 
 ALL_RULES: Dict[str, object] = {}
 for _mod in (jit_hygiene, perf, hygiene, pallas_guards, concurrency, decode,
-             persist):
+             persist, obs):
     for _rule in _mod.RULES:
         assert _rule.id not in ALL_RULES, f"duplicate rule id {_rule.id}"
         ALL_RULES[_rule.id] = _rule

@@ -1,0 +1,187 @@
+"""Telemetry-layer rule.
+
+``obs-device-sync`` — the telemetry spine's hard constraint is that no
+instrumentation point may add a device host-sync or a new compile: every
+interesting serving event already happens at a chunk boundary on the
+host thread (the O(1)-state dividend), so metrics/trace/flight code must
+be PURE host code. Two scopes enforce that:
+
+1. **the obs package** (``orion_tpu/obs/``): importing jax/jaxlib at
+   all, any ``jax.*``/``jnp.*`` dotted call, ``.block_until_ready()`` /
+   ``.item()``, ``float()``/``int()`` calls (the classic
+   concretize-a-device-scalar syncs — obs code must receive host
+   numbers, never coerce), and ``np.asarray``/``jax.device_get`` are all
+   findings. A device array should not even be REACHABLE from obs code;
+   banning the jax import makes ``__getitem__``-style syncs structurally
+   impossible rather than pattern-matched.
+
+2. **registered hooks** (any ``orion_tpu/`` module): a function handed
+   to the spine as a callback — ``gauge_fn(...)`` callables, inject
+   ``add_observer`` subscribers, ``attach_inject`` targets, and
+   callables bound to the hook keywords ``on_event`` / ``on_transition``
+   / ``on_done`` / ``on_stop`` / ``observer`` — runs on the scheduler's
+   hot path (chunk boundaries, signal delivery, metric scrapes). Inside
+   such functions (named functions resolved same-module, plus inline
+   lambdas), the sync-shaped calls above and any ``jax.``/``jnp.``
+   dotted call are findings.
+
+The ``decode-host-sync`` probe budget is untouched: that rule gates the
+decode LOOPS; this one gates the telemetry layer those loops report
+into. Together they pin the acceptance criterion "zero per-chunk host
+syncs with telemetry fully on" statically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from orion_tpu.analysis.findings import Finding
+from orion_tpu.analysis.lint import ModuleContext, dotted_name
+
+_SYNC_ATTRS = frozenset({"block_until_ready", "item"})
+_SYNC_NAMES = frozenset({"float", "int"})
+_SYNC_DOTTED = frozenset({
+    "np.asarray", "numpy.asarray", "onp.asarray", "jax.device_get",
+})
+_BANNED_IMPORT_ROOTS = ("jax", "jaxlib")
+# call names whose function-valued arguments become spine hooks
+_HOOK_CALL_NAMES = frozenset({"gauge_fn", "add_observer", "attach_inject"})
+_HOOK_KEYWORDS = frozenset({
+    "on_event", "on_transition", "on_done", "on_stop", "observer",
+    "on_stall",
+})
+
+
+def _is_obs_module(path: str) -> bool:
+    return "orion_tpu/obs/" in path or path.startswith("obs/")
+
+
+def _sync_label(node: ast.Call) -> Optional[str]:
+    """Is this call sync-shaped, and how do we print it? (Superset of
+    decode-host-sync's set: int() concretizes a device scalar exactly
+    like float() does.)"""
+    name = dotted_name(node.func)
+    if name in _SYNC_NAMES or name in _SYNC_DOTTED:
+        return f"{name}()"
+    if name is not None and (
+        name.startswith("jax.") or name.startswith("jnp.")
+    ):
+        return f"{name}()"
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_ATTRS:
+        return f".{node.func.attr}()"
+    return None
+
+
+def _hook_functions(ctx: ModuleContext) -> List[ast.AST]:
+    """Function defs (and lambdas) registered as metric/trace/flight
+    hooks: passed positionally to gauge_fn/add_observer, or bound to a
+    hook keyword anywhere in the module. Named references resolve to
+    same-module defs; ``self._method`` references resolve by attribute
+    name."""
+    by_name = {}
+    for fn in ctx.function_defs:
+        by_name.setdefault(fn.name, []).append(fn)
+    hooks: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def claim(expr: ast.AST) -> None:
+        if isinstance(expr, ast.Lambda):
+            if id(expr) not in seen:
+                seen.add(id(expr))
+                hooks.append(expr)
+            return
+        name = dotted_name(expr)
+        if not name:
+            return
+        for fn in by_name.get(name.rsplit(".", 1)[-1], []):
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                hooks.append(fn)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            leaf = callee.rsplit(".", 1)[-1] if callee else ""
+            if leaf in _HOOK_CALL_NAMES:
+                for arg in node.args:
+                    claim(arg)
+            for kw in node.keywords:
+                if kw.arg in _HOOK_KEYWORDS:
+                    claim(kw.value)
+        elif isinstance(node, ast.Assign):
+            # `pending.on_done = fn` — hook registration by assignment
+            for target in node.targets:
+                if (isinstance(target, ast.Attribute)
+                        and target.attr in _HOOK_KEYWORDS):
+                    claim(node.value)
+    return hooks
+
+
+class ObsDeviceSyncRule:
+    id = "obs-device-sync"
+    title = "device sync / jax usage in the telemetry layer"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.is_test:
+            return
+        in_obs = _is_obs_module(ctx.path)
+        if in_obs:
+            yield from self._check_obs_module(ctx)
+        if not ctx.path.startswith("orion_tpu/") and not in_obs:
+            return
+        yield from self._check_hooks(ctx)
+
+    def _check_obs_module(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".", 1)[0]
+                    if root in _BANNED_IMPORT_ROOTS:
+                        yield Finding(
+                            self.id, ctx.path, node.lineno,
+                            f"import {alias.name} inside orion_tpu/obs/: "
+                            "the telemetry spine is host-only by contract "
+                            "— a device value must be concretized at the "
+                            "chunk boundary that produced it, never "
+                            "inside a metric/trace/flight path",
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                root = (node.module or "").split(".", 1)[0]
+                if root in _BANNED_IMPORT_ROOTS:
+                    yield Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"from {node.module} import ... inside "
+                        "orion_tpu/obs/: the telemetry spine is host-only "
+                        "by contract (see module docstring)",
+                    )
+            elif isinstance(node, ast.Call):
+                sync = _sync_label(node)
+                if sync is not None:
+                    yield Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"{sync} inside orion_tpu/obs/: telemetry code "
+                        "must receive host numbers, never concretize or "
+                        "sync — pass plain ints/floats in from the chunk "
+                        "boundary that already holds them",
+                    )
+
+    def _check_hooks(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in _hook_functions(ctx):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                sync = _sync_label(node)
+                if sync is not None:
+                    yield Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"{sync} inside a function registered as a "
+                        "metric/trace/flight hook: hooks run on the "
+                        "scheduler's chunk-boundary hot path (or in "
+                        "signal context) — a device sync there stalls "
+                        "every resident slot once per chunk; record the "
+                        "host mirror instead",
+                    )
+
+
+RULES = [ObsDeviceSyncRule()]

@@ -67,6 +67,23 @@ def build_argparser() -> argparse.ArgumentParser:
                         "replica's pool spans every CPU and N replicas "
                         "fight for the same cores instead of scaling")
     p.add_argument("--deadline-ms", type=float, default=0.0)
+    p.add_argument("--metrics-path", default=None,
+                   help="fleet-AGGREGATED Prometheus-text metrics dump "
+                        "(+ .json with the per-replica breakdown), "
+                        "written on exit; each replica also dumps its "
+                        "own registry at <path>.<replica> while serving")
+    p.add_argument("--trace-path", default=None,
+                   help="request-trace output: the router and every "
+                        "replica write Chrome trace-event JSONL "
+                        "(<path>.<name>.jsonl), merged on exit into "
+                        "<path> — one Perfetto-loadable file where a "
+                        "turn that migrated across replicas is one "
+                        "connected trace")
+    p.add_argument("--flight-dir", default=None,
+                   help="flight-recorder dump directory for the parent "
+                        "(router/supervisor black box) AND every "
+                        "replica; dumps fire on DEGRADED/drain/ladder "
+                        "exhaustion/child exit")
     p.add_argument("--heartbeat-s", type=float, default=1.0,
                    help="supervisor heartbeat interval")
     p.add_argument("--grace", type=float, default=30.0)
@@ -102,30 +119,73 @@ def _spec_from_args(args) -> ReplicaSpec:
     )
 
 
+def _obs_serve_overrides(args, name: str) -> dict:
+    """Per-replica telemetry paths (ServeConfig kwargs): each child gets
+    its own metrics/trace file keyed by the replica name, all mergeable/
+    aggregatable in the parent afterwards."""
+    out = {}
+    if args.metrics_path:
+        out["metrics_path"] = f"{args.metrics_path}.{name}"
+    if args.trace_path:
+        out["trace_path"] = f"{args.trace_path}.{name}.jsonl"
+    if args.flight_dir:
+        out["flight_dir"] = args.flight_dir
+    return out
+
+
 def main(argv=None) -> int:
+    import dataclasses
+
     args = build_argparser().parse_args(argv)
     if args.session_id and not args.session_dir:
         print("--session-id requires --session-dir", file=sys.stderr)
         return 2
     spec = _spec_from_args(args)
 
+    # parent-side telemetry: the router's root spans and the supervisor/
+    # control-channel black box (children configure their own from the
+    # per-replica ServeConfig overrides below)
+    tracer = None
+    if args.trace_path:
+        import time as _time
+
+        from orion_tpu.obs.trace import Tracer
+
+        # same clock as every replica Server's tracer (Server defaults
+        # to time.monotonic): merge_traces sorts by ts, and root spans
+        # on a different clock epoch would detach from the chunk spans
+        # they contain
+        tracer = Tracer(path=f"{args.trace_path}.router.jsonl",
+                        clock=_time.monotonic)
+    if args.flight_dir:
+        from orion_tpu.obs import flight
+
+        flight.configure(dump_dir=args.flight_dir)
+
+    def _spec_for(name: str) -> ReplicaSpec:
+        obs = _obs_serve_overrides(args, name)
+        if not obs:
+            return spec
+        return dataclasses.replace(
+            spec, serve={**(spec.serve or {}), **obs}
+        )
+
     if args.local:
         model, params = build_model(spec)
 
         def factory(name: str):
             return LocalReplica(
-                model, params, serve_config(spec), name=name
+                model, params, serve_config(_spec_for(name)), name=name
             ).start()
     else:
-        import dataclasses
         import os
 
         def factory(name: str):
-            s = spec
+            s = _spec_for(name)
             if args.pin_cores:
                 idx = Supervisor.replica_index(name)
                 s = dataclasses.replace(
-                    spec, compute_cpus=[idx % (os.cpu_count() or 1)]
+                    s, compute_cpus=[idx % (os.cpu_count() or 1)]
                 )
             return ProcessReplica(s, name=name).start()
 
@@ -147,10 +207,12 @@ def main(argv=None) -> int:
 
     sup = Supervisor(
         factory, args.replicas, max_inflight=args.max_inflight,
+        tracer=tracer,
     ).start()
     sup.start_monitor(interval=args.heartbeat_s)
     rc = 0
     completed = []
+    aggregated = None
     try:
         import numpy as np
 
@@ -200,9 +262,46 @@ def main(argv=None) -> int:
             print(line + tok.decode(ids) + tag)
         snap = sup.router.snapshot()
         print(f"fleet: {snap}", file=sys.stderr)
+        if args.metrics_path:
+            # scrape while the children still answer status — after the
+            # drain there is nobody to ask
+            aggregated = sup.aggregate_metrics()
     finally:
         sup.drain_all(timeout=args.grace * 2)
+        _dump_fleet_obs(args, tracer, aggregated)
     return rc
+
+
+def _dump_fleet_obs(args, tracer, aggregated) -> None:
+    """Post-drain exposition: the fleet-aggregated metrics (Prometheus
+    text + JSON with the per-replica breakdown) and the merged
+    Perfetto-loadable trace (router root spans + every replica's spans
+    in one file)."""
+    import glob
+    import json as _json
+    import os
+
+    if aggregated is not None and args.metrics_path:
+        from orion_tpu.obs.metrics import prometheus_from_snapshot
+
+        with open(args.metrics_path + ".tmp", "w") as f:
+            f.write(prometheus_from_snapshot(aggregated))
+        os.replace(args.metrics_path + ".tmp", args.metrics_path)
+        with open(args.metrics_path + ".json.tmp", "w") as f:
+            _json.dump(aggregated, f, indent=1, default=repr)
+        os.replace(args.metrics_path + ".json.tmp",
+                   args.metrics_path + ".json")
+        print(f"fleet metrics: {args.metrics_path} (+ .json)",
+              file=sys.stderr)
+    if tracer is not None and args.trace_path:
+        from orion_tpu.obs.trace import merge_traces
+
+        tracer.flush()
+        parts = sorted(glob.glob(args.trace_path + ".*.jsonl"))
+        n = merge_traces(parts, args.trace_path)
+        print(f"fleet trace: {n} events merged into {args.trace_path} "
+              f"from {len(parts)} file(s) — load in Perfetto "
+              "(ui.perfetto.dev)", file=sys.stderr)
 
 
 if __name__ == "__main__":

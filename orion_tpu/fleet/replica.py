@@ -50,6 +50,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from orion_tpu.obs import flight
 from orion_tpu.resilience.inject import fire
 from orion_tpu.serving.session import DecodeRequest, DecodeResult
 
@@ -274,6 +275,10 @@ class FleetPending:
     result: Optional[DecodeResult] = None
     error: Optional[Exception] = None
     replica: str = ""
+    # invoked exactly once right after ``done`` fires (result OR error) —
+    # the router closes its root ``turn`` trace span here; host-only,
+    # exceptions swallowed by the caller
+    on_done: Optional[Callable[["FleetPending"], None]] = None
 
     def wait(self, timeout: Optional[float] = None) -> Optional[DecodeResult]:
         if not self.done.wait(timeout=timeout):
@@ -281,6 +286,15 @@ class FleetPending:
         if self.error is not None:
             raise self.error
         return self.result
+
+    def _release(self) -> None:
+        self.done.set()
+        cb = self.on_done
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass  # telemetry must never break completion
 
 
 # -- the uniform handle interface ---------------------------------------------
@@ -437,9 +451,14 @@ class ProcessReplica(ReplicaHandle):
             self._proc.stdin.flush()
 
     def _send(self, obj: dict) -> None:
+        # black-box every control-channel op (parent side): after a chaos
+        # event the ring shows the op sequence the child saw last
+        flight.record("control_op", replica=self.name, op=obj.get("op"))
         try:
             self._send_raw(json.dumps(obj))
         except (OSError, ValueError, BrokenPipeError, AssertionError) as e:
+            flight.record("control_io_error", replica=self.name,
+                          error=type(e).__name__)
             raise ReplicaGone(
                 f"{self.name}: control channel write failed ({e})"
             ) from e
@@ -459,6 +478,11 @@ class ProcessReplica(ReplicaHandle):
         # EOF: the child exited (clean drain or crash)
         self._eof = True
         self.exit_rc = proc.poll()
+        flight.record("replica_exit", replica=self.name, rc=self.exit_rc)
+        if self.exit_rc not in (0, None):
+            # unhandled child exit: a flight-recorder dump trigger — the
+            # parent's ring holds the control ops that preceded the death
+            flight.recorder().dump(f"child-exit-{self.name}")
         self._fail_outstanding(
             ReplicaGone(f"{self.name}: replica exited (rc={self.exit_rc})")
         )
@@ -488,7 +512,7 @@ class ProcessReplica(ReplicaHandle):
                 pending.result = _result_from_wire(msg)
             pending.done_at = self._clock()
             pending.replica = self.name
-            pending.done.set()
+            pending._release()
 
     def _fail_outstanding(self, err: Exception) -> None:
         with self._state_lock:
@@ -501,7 +525,7 @@ class ProcessReplica(ReplicaHandle):
             if not p.done.is_set():
                 p.error = err
                 p.done_at = self._clock()
-                p.done.set()
+                p._release()
         for q in replies:
             q.put({"ok": False, "error": "ReplicaGone", "message": str(err)})
 

@@ -38,8 +38,9 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
+from orion_tpu.obs.trace import Tracer
 from orion_tpu.resilience.inject import fire
 from orion_tpu.serving.server import OverloadError, RejectedError
 from orion_tpu.serving.session import DecodeRequest
@@ -59,14 +60,24 @@ class Router:
         replicas: List[ReplicaHandle],
         max_inflight: int = 0,
         clock: Callable[[], float] = time.monotonic,
+        tracer: Optional[Tracer] = None,
     ):
         self.replicas = list(replicas)
         self.max_inflight = int(max_inflight)  # 0 = unbounded fleet queue
         self._clock = clock
+        # the fleet's root spans: the router opens one ``turn`` span per
+        # dispatched request (id = the session id + turn ordinal when
+        # there is one), closed when the pending resolves — so a
+        # conversation that migrates across replicas is ONE connected
+        # trace once the per-replica files are merged
+        self.trace = tracer if tracer is not None else Tracer(
+            path=None, clock=clock, enabled=False,
+        )
         self._lock = threading.RLock()
         self._active_sessions: Dict[str, object] = {}  # sid -> pending
         self._dispatches = 0  # fleet.dispatch's step address
         self._dispatching = 0  # submits between admission check and wire ack
+        self._turn_seq = 0  # root-span ordinal (trace ids stay unique)
         self.stats: Dict[str, int] = {
             "dispatched": 0, "shed": 0, "rejected": 0, "failovers": 0,
         }
@@ -152,6 +163,15 @@ class Router:
                     session_id=sid, done=threading.Event()
                 )
                 self._active_sessions[sid] = reservation
+            self._turn_seq += 1
+            tid = (f"{sid}:{self._turn_seq}" if sid is not None
+                   else f"turn-{self._turn_seq}")
+        # the fleet-level root span: opened BEFORE placement, closed when
+        # the pending resolves (or right here if nothing could take it) —
+        # merged with the replicas' trace files this connects a turn's
+        # whole story across processes, keyed by the session id in args
+        self.trace.begin("turn", tid, cat="fleet", session=sid)
+        placed = False
         failures = []
         overloads = 0
         owed = True  # does _dispatching still carry this request?
@@ -194,6 +214,10 @@ class Router:
                     if sid is not None:
                         self._active_sessions[sid] = pending
                         reservation = None
+                self.trace.instant("dispatched", cat="fleet", id=tid,
+                                   replica=replica.name)
+                self._attach_turn_close(pending, tid)
+                placed = True
                 return pending
             with self._lock:
                 if overloads:
@@ -213,6 +237,9 @@ class Router:
                 + "; ".join(f"{n}: {type(e).__name__}" for n, e in failures)
             )
         finally:
+            if not placed:
+                # nothing took the request: the root span still pairs
+                self.trace.end("turn", tid, cat="fleet", status="unplaced")
             with self._lock:
                 if owed:
                     self._dispatching -= 1
@@ -220,6 +247,32 @@ class Router:
                     self._active_sessions.get(sid) is reservation
                 ):
                     del self._active_sessions[sid]
+
+    def _attach_turn_close(self, pending, tid: str) -> None:
+        """Close the root ``turn`` span EXACTLY once when ``pending``
+        resolves. ``on_done`` may already have missed the resolution (a
+        fast replica can finish between submit and here), so a
+        done-already pending closes immediately; a non-blocking
+        once-lock arbitrates the race — exactly one of the two possible
+        callers wins it, so the span can neither double-close nor leak
+        unclosed."""
+        once = threading.Lock()
+
+        def _close(p) -> None:
+            if not once.acquire(blocking=False):
+                return
+            err = getattr(p, "error", None)
+            result = getattr(p, "result", None)
+            status = (
+                f"error:{type(err).__name__}" if err is not None
+                else (result.status if result is not None else "?")
+            )
+            self.trace.end("turn", tid, cat="fleet", status=status,
+                           replica=getattr(p, "replica", ""))
+
+        pending.on_done = _close
+        if pending.done.is_set():
+            _close(pending)
 
     # -- observability --------------------------------------------------------
 

@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from orion_tpu.obs import flight
+from orion_tpu.obs import metrics as obs_metrics
 from orion_tpu.resilience.inject import fire
 from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
 
@@ -62,6 +64,7 @@ class Supervisor:
         ready_timeout: float = 240.0,
         spawn_retry: Optional[RetryPolicy] = None,
         clock: Callable[[], float] = time.monotonic,
+        tracer=None,
     ):
         assert n >= 1, n
         self.factory = factory
@@ -74,6 +77,7 @@ class Supervisor:
             spawn_retry if spawn_retry is not None else RetryPolicy(attempts=3)
         )
         self._clock = clock
+        self._tracer = tracer
         self._max_inflight = int(max_inflight)
         self._spawn_count = 0  # fleet.replica_spawn's step address
         self._misses: dict = {}
@@ -88,7 +92,8 @@ class Supervisor:
     def start(self) -> "Supervisor":
         self.replicas = [self._spawn(i) for i in range(self.n)]
         self.router = Router(
-            self.replicas, max_inflight=self._max_inflight, clock=self._clock
+            self.replicas, max_inflight=self._max_inflight,
+            clock=self._clock, tracer=self._tracer,
         )
         # the router holds the SAME list object; replacements mutate it
         self.replicas = self.router.replicas
@@ -123,6 +128,10 @@ class Supervisor:
 
     def _event(self, name: str, what: str) -> None:
         self.events.append((self._clock(), name, what))
+        # the supervision audit log doubles as black-box context: every
+        # spawn/drain/kill/heartbeat-miss lands in the default flight
+        # ring beside the control ops and fault deliveries
+        flight.record("supervisor", replica=name, what=what)
         print(f"[fleet] {name}: {what}", file=sys.stderr)
 
     # -- healing --------------------------------------------------------------
@@ -179,6 +188,32 @@ class Supervisor:
         # built the router (the replicas list IS the router's list)
         assert self.router is not None
         self.router.replace(old, new)
+
+    # -- fleet-level observability --------------------------------------------
+
+    def aggregate_metrics(self) -> dict:
+        """ONE fleet-level metrics view from every live replica's
+        registry, scraped over the existing line-JSON ``status`` op (the
+        Server's snapshot carries its registry since ISSUE 9): counters
+        and histograms sum, gauges add across replicas, and the raw
+        per-replica snapshots ride in ``by_source``. A replica that
+        misses the scrape is simply absent — aggregation must not block
+        on a wedged child longer than the heartbeat timeout."""
+        snaps, names = [], []
+        for replica in list(self.replicas):
+            status = replica.status(timeout=self.heartbeat_timeout)
+            if status is None:
+                status = getattr(replica, "last_status", None)
+            if status is None:
+                continue
+            m = status.get("metrics")
+            if m is None:
+                continue
+            snaps.append(m)
+            names.append(replica.name)
+        agg = obs_metrics.aggregate(snaps, sources=names)
+        agg["replicas"] = len(names)
+        return agg
 
     # -- monitor thread -------------------------------------------------------
 

@@ -1,0 +1,37 @@
+"""Telemetry spine: one observability layer for serving, fleet, training.
+
+Three pieces, all host-only (the ``obs-device-sync`` lint rule enforces
+that nothing here — or registered here as a hook — may import jax or
+sync a device value; the paper's O(1)-state decode means every
+interesting event already happens at a chunk boundary on the host
+thread, so full telemetry costs host timestamps, never a device sync):
+
+- :mod:`metrics` — :class:`~orion_tpu.obs.metrics.MetricsRegistry`:
+  counters, gauges (stored and callable), fixed-bucket histograms;
+  label sets; one lock; snapshot-consistent reads; Prometheus-text +
+  JSON exposition; :func:`~orion_tpu.obs.metrics.aggregate` for the
+  fleet-level rollup.
+- :mod:`trace` — :class:`~orion_tpu.obs.trace.Tracer`: Chrome
+  trace-event JSONL — a span per request lifecycle (queue wait →
+  admission/staging → prefill pieces → decode chunks →
+  eviction/suspension/failure), recorded from host-side scheduler
+  state; the fleet router opens the root span so a turn that migrates
+  across replicas is one connected trace;
+  :func:`~orion_tpu.obs.trace.merge_traces` produces the
+  Perfetto-loadable document.
+- :mod:`flight` — :class:`~orion_tpu.obs.flight.FlightRecorder`: a
+  bounded ring of recent structured events (admissions, evictions,
+  ladder rungs, health transitions, fault deliveries, watchdog beats,
+  control-channel ops) that auto-dumps to the run directory on
+  DEGRADED/DEAD transitions, ladder exhaustion, SIGTERM drain, and
+  unhandled child exit.
+"""
+
+from orion_tpu.obs.flight import FlightRecorder
+from orion_tpu.obs.metrics import MetricsRegistry, aggregate
+from orion_tpu.obs.trace import Tracer, merge_traces, read_jsonl, span_pairs
+
+__all__ = [
+    "MetricsRegistry", "aggregate", "Tracer", "merge_traces",
+    "read_jsonl", "span_pairs", "FlightRecorder",
+]

@@ -128,6 +128,36 @@ class _Fault:
     action: Optional[Callable[[], None]]  # None = marker (consumed via query)
 
 
+# delivery observers (the telemetry spine's black box): every DELIVERED
+# fault — marker or action, any site — is reported to each subscribed
+# callback as (site, step) AFTER the plan lock is released (an observer
+# that records, dumps, or logs must never run under the delivery lock).
+# The flight recorder (orion_tpu/obs/flight.py) subscribes here so an
+# injected fault can never fire without leaving a trace in the ring —
+# the site⇄event parity the chaos meta-test asserts.
+_observers: List[Callable[[str, Optional[int]], None]] = []
+
+
+def add_observer(fn: Callable[[str, Optional[int]], None]) -> None:
+    if fn not in _observers:
+        _observers.append(fn)
+
+
+def remove_observer(fn: Callable[[str, Optional[int]], None]) -> None:
+    try:
+        _observers.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify_delivery(site: str, step: Optional[int]) -> None:
+    for fn in list(_observers):
+        try:
+            fn(site, step)
+        except Exception:
+            pass  # a broken observer must never mask the fault itself
+
+
 class FaultPlan:
     """An ordered set of faults to deliver. Thread-safe: the data-loader
     worker and the main thread both fire hooks."""
@@ -217,6 +247,7 @@ class FaultPlan:
     # -- delivery ------------------------------------------------------------
 
     def _take(self, site: str, step: Optional[int]) -> Optional[_Fault]:
+        taken = None
         with self._lock:
             for f in self._faults:
                 if f.site != site or f.times == 0:
@@ -228,8 +259,13 @@ class FaultPlan:
                 if f.times > 0:
                     f.times -= 1
                 self.delivered.append(f"{site}@{step}")
-                return f
-        return None
+                taken = f
+                break
+        if taken is not None:
+            # outside the lock: observers (the flight recorder) may take
+            # their own locks or write files
+            _notify_delivery(site, step)
+        return taken
 
     def fire(self, site: str, step: Optional[int] = None) -> None:
         f = self._take(site, step)
@@ -389,4 +425,5 @@ __all__ = [
     "decode_nan_armed", "decode_slot_nan_armed", "corrupt_step",
     "truncate_step", "corrupt_session", "truncate_session",
     "SITES", "SITE_PREFIXES", "known_site",
+    "add_observer", "remove_observer",
 ]

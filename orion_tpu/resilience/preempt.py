@@ -32,10 +32,17 @@ class PreemptionGuard:
         grace: float = 10.0,
         signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT),
         clock: Callable[[], float] = time.monotonic,
+        on_stop: Optional[Callable[[int], None]] = None,
     ):
         self.grace = float(grace)
         self._signals = signals
         self._clock = clock
+        # telemetry tap: called once with the signal number when the
+        # graceful-stop request is recorded. It runs from the signal
+        # handler context, so it must only touch memory (append to a
+        # flight ring) — no I/O, no locks (signal-unsafe-handler rule;
+        # the flight recorder's deque append qualifies).
+        self._on_stop = on_stop
         self._orig: Dict[int, object] = {}
         self._requested_at: Optional[float] = None
         self._signum: Optional[int] = None
@@ -74,6 +81,11 @@ class PreemptionGuard:
             return
         self._requested_at = self._clock()
         self._signum = signum
+        if self._on_stop is not None:
+            try:
+                self._on_stop(signum)
+            except Exception:
+                pass  # telemetry must never break the stop request
         # os.write, not sys.stderr.write: the handler runs between two
         # arbitrary bytecodes, and buffered io locks internally — if the
         # interrupted code holds that lock (a log line mid-flush), a
@@ -91,6 +103,11 @@ class PreemptionGuard:
         if self._requested_at is None:
             self._requested_at = self._clock()
             self._signum = signum
+            if self._on_stop is not None:
+                try:
+                    self._on_stop(signum)
+                except Exception:
+                    pass
 
     # -- trainer-facing API --------------------------------------------------
 

@@ -57,11 +57,17 @@ class Watchdog:
         monitor: bool = True,
         poll_interval: Optional[float] = None,
         label: str = "train step",
+        observer: Optional[Callable[[str, str], None]] = None,
     ):
         assert timeout > 0, timeout
         self.timeout = float(timeout)
         self._clock = clock
         self._on_stall = on_stall  # None = built-in escalating handler
+        # telemetry tap (the flight recorder): called as ("beat", label)
+        # on every heartbeat and ("stall", diagnosis) on every trip —
+        # must be host-only and cheap (lint rule obs-device-sync covers
+        # functions registered as flight hooks)
+        self._observer = observer
         self._label = label
         self._lock = threading.Lock()
         self._last = self._clock()
@@ -98,6 +104,11 @@ class Watchdog:
             self.trip_attempt = 0
             if label is not None:
                 self._label = label
+        if self._observer is not None:
+            try:
+                self._observer("beat", self._label)
+            except Exception:
+                pass  # telemetry must never fail a heartbeat
 
     def disarm(self) -> None:
         """Pause detection (e.g. across a legitimately unbounded phase)."""
@@ -137,6 +148,11 @@ class Watchdog:
         diag = self._stalled()
         if diag is not None:
             self.last_stall = diag
+            if self._observer is not None:
+                try:
+                    self._observer("stall", diag)
+                except Exception:
+                    pass  # telemetry must never mask the StallError
             raise StallError(diag)
 
     def close(self) -> None:
@@ -151,6 +167,11 @@ class Watchdog:
             diag = self._stalled()
             if diag is not None:
                 self.last_stall = diag
+                if self._observer is not None:
+                    try:
+                        self._observer("stall", diag)
+                    except Exception:
+                        pass  # telemetry must never mask the stall
                 try:
                     if self._on_stall is not None:
                         self._on_stall(diag)

@@ -93,6 +93,23 @@ def build_argparser() -> argparse.ArgumentParser:
                         "boundaries (state stays on disk; 0 = off)")
     p.add_argument("--grace", type=float, default=30.0,
                    help="SIGTERM drain budget (seconds)")
+    p.add_argument("--metrics-path", default=None,
+                   help="Prometheus-text metrics exposition file (+ a "
+                        ".json sibling), rewritten atomically every "
+                        "--metrics-interval-s at chunk boundaries and "
+                        "always on drain")
+    p.add_argument("--metrics-interval-s", type=float, default=10.0,
+                   help="periodic metrics dump cadence (<= 0: on drain "
+                        "only)")
+    p.add_argument("--trace-path", default=None,
+                   help="request-trace JSONL (Chrome trace events): one "
+                        "span per request lifecycle, chunk spans at "
+                        "boundary granularity; merge with `python -m "
+                        "orion_tpu.obs.trace merge` and load in Perfetto")
+    p.add_argument("--flight-dir", default=None,
+                   help="flight-recorder dump directory: the black box "
+                        "auto-dumps here on DEGRADED/DRAINING/DEAD, "
+                        "ladder exhaustion, and SIGTERM drain")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
@@ -185,6 +202,9 @@ def _run(args, guard) -> int:
             prefill_chunk=args.prefill_chunk,
             prompt_overflow=args.prompt_overflow,
             session_dir=args.session_dir, session_idle_s=args.session_idle_s,
+            metrics_path=args.metrics_path,
+            metrics_interval_s=args.metrics_interval_s,
+            trace_path=args.trace_path, flight_dir=args.flight_dir,
         ),
     )
     if args.session_dir and server.session_store is not None:
@@ -249,9 +269,15 @@ def _run(args, guard) -> int:
     print(f"stats: {server.stats}", file=sys.stderr)
     mode = (f"in-scan prefill, {server.engine.prefill_chunk} tok/boundary"
             if args.prefill_chunk else "host prefill")
-    print(f"slot occupancy: {server.occupancy():.3f} "
+    print(f"slot occupancy: {server.occupancy_lifetime():.3f} "
           f"({args.slots} slot(s), chunk {args.chunk}, {mode})",
           file=sys.stderr)
+    if args.metrics_path:
+        print(f"metrics: {args.metrics_path} (+ .json)", file=sys.stderr)
+    if args.trace_path:
+        print(f"trace: {args.trace_path} — merge for Perfetto with "
+              f"`python -m orion_tpu.obs.trace merge {args.trace_path} "
+              f"-o trace.json`", file=sys.stderr)
     return rc
 
 

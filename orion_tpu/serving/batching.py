@@ -252,6 +252,7 @@ class SlotEngine:
         prefill_buckets: Tuple[int, ...] = (),
         prefill_chunk: int = 0,
         prompt_overflow: str = "error",
+        on_event: Optional[Callable[[str, dict], None]] = None,
     ):
         assert slots > 0, slots
         assert chunk > 0, chunk
@@ -261,6 +262,14 @@ class SlotEngine:
         self.slots = int(slots)
         self.chunk = int(chunk)
         self._clock = clock
+        # telemetry tap (obs/): called with (kind, fields) at admissions,
+        # prefill-piece consumption, ladder rungs, and evictions — every
+        # field is a HOST value the scheduler already holds (slot index,
+        # chunk ordinal, the tag), so the hook costs dict construction,
+        # never a device sync (lint rules decode-host-sync +
+        # obs-device-sync gate this). The Server wires it to its flight
+        # recorder / metrics registry.
+        self._on_event = on_event
         self.buckets = tuple(prefill_buckets)
         self.prompt_overflow = prompt_overflow
         cfg = model.cfg
@@ -313,6 +322,10 @@ class SlotEngine:
         self._pbuf: Optional[Array] = None
         self._done_np = np.ones((self.slots,), bool)
 
+    def _emit(self, kind: str, **fields) -> None:
+        if self._on_event is not None:
+            self._on_event(kind, fields)
+
     # -- occupancy ------------------------------------------------------------
 
     @property
@@ -345,6 +358,20 @@ class SlotEngine:
             "prefilling": prefilling,
             "decoding": self.active_count - prefilling,
         }
+
+    def slot_info(self) -> List[Tuple[int, Any, str, int]]:
+        """Per-resident-slot (index, tag, phase, request-local chunk
+        ordinal) — the host-side view the tracer turns into per-chunk
+        spans. ``phase`` splits the lifecycle the way the trace taxonomy
+        does: ``"prefill"`` while the staged prompt is unconsumed,
+        ``"decode"`` after. Pure host bookkeeping, no readback."""
+        out = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            phase = "prefill" if slot.prompt_remaining > 0 else "decode"
+            out.append((i, slot.tag, phase, slot.chunks))
+        return out
 
     # -- admission ------------------------------------------------------------
 
@@ -431,6 +458,12 @@ class SlotEngine:
             seed=seed,
             target_new=request.max_new_tokens,
             fold_base=sample_index,
+        )
+        self._emit(
+            "admit", slot=i, tag=tag,
+            staged=bool(self.prefill_chunk),
+            prompt_len=int(prompt.shape[1]),
+            session=session_id,
         )
         return i
 
@@ -538,6 +571,10 @@ class SlotEngine:
             fold_base=int(sess.emit),
             served_base=int(sess.served),
         )
+        self._emit(
+            "resume", slot=i, tag=tag, session=sess.session_id,
+            t=int(sess.t), generation=int(sess.generation),
+        )
         return i
 
     def _insert(self, i: int, sub_carry, rng: Array, n_emitted: int = 0) -> None:
@@ -595,7 +632,11 @@ class SlotEngine:
                 slot.chunks += 1
                 if i != sel:
                     continue  # frozen: another slot had the budget
-                slot.prompt_remaining -= min(piece, slot.prompt_remaining)
+                consumed = min(piece, slot.prompt_remaining)
+                slot.prompt_remaining -= consumed
+                self._emit("prefill_piece", slot=i, tag=slot.tag,
+                           consumed=consumed,
+                           remaining=slot.prompt_remaining)
                 if slot.prompt_remaining > 0:
                     continue  # still mid-prefill: emitted nothing yet
                 slot.toks.append((toks, i))
@@ -708,6 +749,8 @@ class SlotEngine:
         bad2 = self._probe_bad(carry, active)
         for i in bad:
             self._slots[i].rewinds += 1
+            self._emit("ladder", rung="rewind", slot=i,
+                       chunk=self._slots[i].chunks, tag=self._slots[i].tag)
         if not bad2:
             return carry, toks, set()
         # rung 2: the snapshot itself is poisoned for the still-bad slots —
@@ -717,6 +760,10 @@ class SlotEngine:
         for i in sorted(bad2):
             snap2 = self._reprefill_into(snap2, i)
             self._slots[i].reprefills += 1
+            rung = ("prefill_restart" if self._slots[i].prompt_remaining > 0
+                    and self.prefill_chunk else "reprefill")
+            self._emit("ladder", rung=rung, slot=i,
+                       chunk=self._slots[i].chunks, tag=self._slots[i].tag)
         carry, toks = self._attempt(snap2, active_dev, unified)
         bad3 = self._probe_bad(carry, active)
         if not bad3:
@@ -726,6 +773,8 @@ class SlotEngine:
         still = np.array(active)
         for i in bad3:
             still[i] = False
+            self._emit("ladder", rung="exhausted", slot=i,
+                       chunk=self._slots[i].chunks, tag=self._slots[i].tag)
         if still.any():
             carry, toks = self._attempt(snap2, jnp.asarray(still), unified)
         return carry, toks, bad3
@@ -809,6 +858,12 @@ class SlotEngine:
         store already holds stays that conversation's truth (the client
         re-submits the turn)."""
         slot = self._slots[i]
+        self._emit(
+            "evict", slot=i, tag=slot.tag, status=status,
+            session=slot.session_id, chunks=slot.chunks,
+            suspended=(slot.session_id is not None and status != "failed"
+                       and slot.prompt_remaining == 0),
+        )
         if (slot.session_id is None or status == "failed"
                 or slot.prompt_remaining > 0):
             return self._evict(i, status)
@@ -869,6 +924,9 @@ class SlotEngine:
         out = []
         for i, slot in enumerate(self._slots):
             if slot is not None:
+                self._emit("evict", slot=i, tag=slot.tag, status=status,
+                           session=slot.session_id, chunks=slot.chunks,
+                           suspended=False, forced=True)
                 out.append((slot.tag, self._evict(i, status)))
         return out
 

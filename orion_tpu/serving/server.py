@@ -53,12 +53,16 @@ import queue
 import sys
 import threading
 import time
+import uuid
 import warnings
 from collections import OrderedDict
 from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from orion_tpu.obs.flight import FlightRecorder
+from orion_tpu.obs.metrics import MetricsRegistry
+from orion_tpu.obs.trace import Tracer
 from orion_tpu.resilience.inject import fire
 from orion_tpu.resilience.preempt import PreemptionGuard
 from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
@@ -66,6 +70,17 @@ from orion_tpu.resilience.watchdog import Watchdog
 from orion_tpu.serving.health import Health, HealthMachine
 from orion_tpu.serving.session import DecodeRequest, DecodeResult
 from orion_tpu.serving.session_store import SessionState, SessionStore
+
+# the Server.stats contract (PR 4-8): these counter names, unlabelled,
+# as one flat dict — now cells of the metrics registry instead of a
+# hand-rolled dict, so they ride every exposition path for free
+_STAT_KEYS = (
+    "admitted", "shed", "rejected",
+    "ok", "deadline", "failed",
+    "rewinds", "reprefills", "stalls",
+    "chunks", "slot_steps_active", "slot_steps_total",
+    "suspended", "resumed", "session_saves",
+)
 
 
 class OverloadError(RuntimeError):
@@ -100,6 +115,19 @@ class ServeConfig:
     session_idle_s: float = 300.0  # resident-cache idle eviction (0 = off)
     max_resident_sessions: int = 64  # LRU cap on the host-resident cache
     session_keep: int = 2  # retained generations per session on disk
+    # -- telemetry (orion_tpu/obs/): all host-side, zero device syncs --
+    # Prometheus text dumped here (+ .json sibling) every
+    # metrics_interval_s at chunk boundaries and always on drain/exit;
+    # None = no exposition (the registry still records)
+    metrics_path: Optional[str] = None
+    metrics_interval_s: float = 10.0  # <= 0: dump on drain only
+    # Chrome trace-event JSONL of request/queue/chunk spans; None = off
+    # (merge files with `python -m orion_tpu.obs.trace merge` for
+    # Perfetto)
+    trace_path: Optional[str] = None
+    # flight-recorder auto-dumps (DEGRADED/DRAINING/DEAD transitions,
+    # ladder exhaustion) land here; None = ring only, no dumps
+    flight_dir: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -115,6 +143,13 @@ class Pending:
     result: Optional[DecodeResult] = None
     error: Optional[Exception] = None
     done_at: float = 0.0
+    # trace identity: the async-span id every event of this request's
+    # lifecycle carries (``<session_id>:<seq>`` for session turns, so a
+    # resumed conversation links across replicas by prefix)
+    rid: str = ""
+    # called exactly once, right after ``done`` fires — the fleet router
+    # ends its root ``turn`` span here; must be host-only and non-raising
+    on_done: Optional[Callable[["Pending"], None]] = None
 
     def wait(self, timeout: Optional[float] = None) -> Optional[DecodeResult]:
         """Block for the outcome: returns the DecodeResult, RAISES the
@@ -161,11 +196,49 @@ class Server:
         params,
         cfg: ServeConfig = ServeConfig(),
         clock: Callable[[], float] = time.monotonic,
+        tracer: Optional[Tracer] = None,
+        flight: Optional[FlightRecorder] = None,
     ):
+        from orion_tpu import generate as _gen
         from orion_tpu.serving.batching import SlotEngine, parse_buckets
 
         self.cfg = cfg
         self._clock = clock
+        # ONE reentrant lock guards the metrics registry AND the health
+        # machine: `snapshot()` reads both under a single acquisition, so
+        # a fleet router polling /healthz can never observe a torn pair
+        # (e.g. the old health state with the new slot gauges). Reentrant
+        # because snapshot() holds it while calling health.snapshot().
+        self._stats_lock = threading.RLock()
+        # -- telemetry spine (orion_tpu/obs/): every instrumentation
+        # point below records HOST values the scheduler already holds at
+        # chunk boundaries — no device syncs, no new compiles (lint rule
+        # obs-device-sync + the cache-stat asserts in tests/test_obs.py)
+        self.metrics = MetricsRegistry(clock=clock, lock=self._stats_lock)
+        for key in _STAT_KEYS:
+            self.metrics.counter(key)  # the legacy stats dict's cells
+        self.trace = tracer if tracer is not None else Tracer(
+            path=cfg.trace_path, clock=clock, enabled=bool(cfg.trace_path),
+        )
+        self.flight = flight if flight is not None else FlightRecorder(
+            clock=clock, dump_dir=cfg.flight_dir,
+        )
+        self._h_chunk_ms = self.metrics.histogram("chunk_ms")
+        self._h_session_save_ms = self.metrics.histogram("session_save_ms")
+        self._h_session_load_ms = self.metrics.histogram("session_load_ms")
+        self._c_ladder = self.metrics.counter("ladder_rungs")
+        self._c_health = self.metrics.counter("health_transitions")
+        self._rid_seq = 0
+        # per-server token inside every trace id: two replicas (or one
+        # replica restarted) sharing a trace file must never collide on
+        # span ids — the session id stays the LINKING key, the token
+        # keeps the spans distinct
+        self._rid_token = uuid.uuid4().hex[:6]
+        self._metrics_next = 0.0
+        self.health = HealthMachine(
+            clock=clock, lock=self._stats_lock,
+            on_transition=self._on_health,
+        )
         self.engine = SlotEngine(
             model, params, slots=cfg.slots, chunk=cfg.chunk, clock=clock,
             prefill_buckets=parse_buckets(
@@ -173,14 +246,32 @@ class Server:
             ),
             prefill_chunk=cfg.prefill_chunk,
             prompt_overflow=cfg.prompt_overflow,
+            on_event=self._on_engine_event,
         )
-        # ONE reentrant lock guards the stats dict AND the health machine:
-        # `snapshot()` reads both under a single acquisition, so a fleet
-        # router polling /healthz can never observe a torn pair (e.g. the
-        # old health state with the new slot gauges). Reentrant because
-        # snapshot() holds it while calling health.snapshot().
-        self._stats_lock = threading.RLock()
-        self.health = HealthMachine(clock=clock, lock=self._stats_lock)
+        # the gauges we used to fly blind on — all callable (evaluated at
+        # scrape time from live host state) and all free: queue depth,
+        # per-slot prefill-vs-decode occupancy, compile-cache sizes
+        self.metrics.gauge_fn("queue_depth", self._q_depth)
+        for key in ("active", "free", "prefilling", "decoding"):
+            self.metrics.gauge_fn(
+                "slots", self._slot_gauge(key), labels={"state": key}
+            )
+        self.metrics.gauge_fn("sessions_resident",
+                              lambda: len(self._sessions))
+        self.metrics.gauge_fn("sessions_in_slots",
+                              lambda: len(self._active_sessions))
+        for label, jitted in (
+            ("decode_batched", _gen._decode_batched_chunk_jit),
+            ("unified_prefill", _gen._decode_batched_prefill_chunk_jit),
+            ("prefill", _gen._prefill_carry_jit),
+            ("prefill_bucketed", _gen._prefill_carry_bucketed_jit),
+        ):
+            # host-side executable-cache introspection, not a device op —
+            # the gauge that proves telemetry added zero compiles
+            self.metrics.gauge_fn(
+                "compile_cache_entries", jitted._cache_size,
+                labels={"cache": label},
+            )
         # durable sessions: write-through disk store + a host-resident LRU
         # cache in front of it (resident entries are ALWAYS also on disk,
         # so idle/LRU eviction is pure cache management, and the race
@@ -193,6 +284,7 @@ class Server:
                 # a DRAINING/DEAD server must not burn its drain grace
                 # backing off on session I/O (resilience/retry.py)
                 should_abort=lambda: not self.health.accepting,
+                observer=self._on_store_io, clock=clock,
             )
         self._sessions: "OrderedDict[str, SessionState]" = OrderedDict()
         self._session_last_use: Dict[str, float] = {}
@@ -210,17 +302,60 @@ class Server:
         # put landing between the serve loop's last empty-check and DEAD
         # would strand a Pending whose done event never fires.
         self._admission_lock = threading.Lock()
-        self.stats: Dict[str, int] = {
-            "admitted": 0, "shed": 0, "rejected": 0,
-            "ok": 0, "deadline": 0, "failed": 0,
-            "rewinds": 0, "reprefills": 0, "stalls": 0,
-            "chunks": 0, "slot_steps_active": 0, "slot_steps_total": 0,
-            "suspended": 0, "resumed": 0, "session_saves": 0,
-        }
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """The PR 4-8 stats dict, read from the registry's unlabelled
+        counter cells (one consistent acquisition). A snapshot — mutate
+        through the registry, not this dict."""
+        flat = self.metrics.counters_flat()
+        return {k: flat.get(k, 0) for k in _STAT_KEYS}
 
     def _bump(self, key: str, n: int = 1) -> None:
-        with self._stats_lock:
-            self.stats[key] += n
+        self.metrics.counter(key).inc(n)
+
+    # -- telemetry hooks (all host-only; see obs-device-sync) -----------------
+
+    def _q_depth(self) -> int:
+        return self._q.qsize()
+
+    def _slot_gauge(self, key: str) -> Callable[[], int]:
+        return lambda: self.engine.occupancy()[key]
+
+    def _on_store_io(self, op: str, ms: float) -> None:
+        (self._h_session_save_ms if op == "save"
+         else self._h_session_load_ms).observe(ms)
+
+    def _on_health(self, old, new, reason: str) -> None:
+        """HealthMachine transition tap (runs AFTER the machine released
+        the shared lock): black-box record + counter, and the flight
+        recorder's auto-dump triggers — DEGRADED (something engaged the
+        ladder / stalled), DRAINING (SIGTERM drain), DEAD."""
+        self.flight.record(
+            "health", frm=old.value if old else None, to=new.value,
+            reason=reason,
+        )
+        self._c_health.inc(labels={"to": new.value})
+        if new in (Health.DEGRADED, Health.DRAINING, Health.DEAD):
+            self.flight.dump(f"health-{new.value}")
+
+    def _on_engine_event(self, kind: str, fields: dict) -> None:
+        """SlotEngine tap: admissions, resumes, prefill pieces, ladder
+        rungs, evictions — recorded to the flight ring (tag swapped for
+        the request's trace id) and folded into the registry."""
+        tag = fields.pop("tag", None)
+        rid = getattr(tag, "rid", None)
+        if rid is not None:
+            fields["req"] = rid
+        self.flight.record(kind, **fields)
+        if kind == "ladder":
+            self._c_ladder.inc(labels={"rung": fields.get("rung", "?")})
+            self.trace.instant("ladder", id=rid, rung=fields.get("rung"),
+                               slot=fields.get("slot"))
+        elif kind in ("admit", "resume"):
+            self.trace.instant(kind, id=rid,
+                               session=fields.get("session"),
+                               slot=fields.get("slot"))
 
     # -- admission ------------------------------------------------------------
 
@@ -240,10 +375,28 @@ class Server:
             if not self.health.accepting:
                 self._bump("rejected")
                 raise RejectedError(f"server is {self.health.state.value}")
+            self._rid_seq += 1
+            pending.rid = (
+                f"{request.session_id}:{self._rid_token}.{self._rid_seq}"
+                if request.session_id is not None
+                else f"req-{self._rid_token}.{self._rid_seq}"
+            )
+            # the request-lifecycle root span + its queue-wait child
+            # open BEFORE the enqueue: the serve loop may pop the
+            # Pending (and emit the matching end events) the instant
+            # put_nowait returns — begins recorded after that would
+            # timestamp after their own ends. A shed request closes
+            # both spans right here, so pairing stays complete on every
+            # path.
+            self.trace.begin("request", pending.rid,
+                             session=request.session_id)
+            self.trace.begin("queue", pending.rid)
             try:
                 self._q.put_nowait(pending)
             except queue.Full:
                 self._bump("shed")
+                self.trace.end("queue", pending.rid)
+                self.trace.end("request", pending.rid, status="shed")
                 raise OverloadError(
                     f"admission queue full ({self.cfg.max_inflight} queued "
                     f"+ up to {self.cfg.slots} resident in slots)"
@@ -272,7 +425,7 @@ class Server:
         if cfg.stall_timeout > 0:
             wd = Watchdog(
                 cfg.stall_timeout, on_stall=self._on_stall, monitor=True,
-                label="serve loop",
+                label="serve loop", observer=self._on_wd,
             )
         with contextlib.ExitStack() as stack:
             if guard is None:
@@ -280,6 +433,11 @@ class Server:
                     PreemptionGuard(grace=cfg.grace, clock=self._clock)
                 )
             self._guard = guard
+            # black-box the serve lifetime: every delivered fault (any
+            # inject site) leaves a ring event, detached on exit so a
+            # test that builds many servers doesn't accrete observers
+            self.flight.attach_inject()
+            stack.callback(self.flight.detach_inject)
             if self.health.state is Health.STARTING:
                 self.health.to(Health.SERVING, "serve loop running")
             clean_exit = False
@@ -303,6 +461,7 @@ class Server:
                         for pending, result in self.engine.suspend_sessions():
                             self._complete(pending, result)
                     self._tick_sessions()
+                    self._tick_metrics()
                     self._admit_from_queue(wd)
                     if not self.engine.busy:
                         if (draining or drain_when_idle) and self._q.empty():
@@ -341,7 +500,29 @@ class Server:
                     if self.health.state is Health.DRAINING:
                         self._reject_leftovers()
                         self.health.to(Health.DEAD, "drained")
+                # exposition on the way out, whatever the exit path:
+                # final metrics scrape + the trace file's tail (both
+                # host-side, both OUTSIDE the timed chunk walk)
+                self._tick_metrics(force=True)
+                self.trace.flush()
         return 0
+
+    def _tick_metrics(self, force: bool = False) -> None:
+        """Periodic metrics exposition at chunk-boundary cadence (and
+        forced on drain/exit). Interval <= 0 means on-drain only; a
+        failing dump never takes the serve loop down."""
+        path = self.cfg.metrics_path
+        if not path:
+            return
+        now = self._clock()
+        if not force and (self.cfg.metrics_interval_s <= 0
+                          or now < self._metrics_next):
+            return
+        self._metrics_next = now + max(self.cfg.metrics_interval_s, 1.0)
+        try:
+            self.metrics.dump(path)
+        except OSError as e:
+            warnings.warn(f"metrics dump failed: {e}", stacklevel=2)
 
     def close(self) -> None:
         """Finalize a server whose loop exited idle: reject anything still
@@ -375,6 +556,7 @@ class Server:
             # next chunk beat — without a beat per admission that wait
             # reads as a stall on a healthy replica
             wd.beat("request admission")
+        self.trace.end("queue", pending.rid)  # queue wait over, either way
         deadline_at = (
             pending.admitted_at + pending.request.deadline_ms / 1000.0
             if pending.request.deadline_ms > 0
@@ -400,9 +582,10 @@ class Server:
             # is corrupt fails ITS request only
             pending.error = e
             self._bump("failed")
+            self.flight.record("refused", req=pending.rid,
+                               error=type(e).__name__)
             self._degrade(f"request refused: {type(e).__name__}: {e}")
-            pending.done_at = self._clock()
-            pending.done.set()
+            self._finalize(pending, "error")
 
     # -- durable sessions -----------------------------------------------------
 
@@ -585,16 +768,30 @@ class Server:
 
     def _step_chunk(self, wd, guard) -> None:
         """One engine boundary: watchdog beat, advance all slots a chunk,
-        complete whatever finished, refresh the occupancy gauges."""
+        complete whatever finished, refresh the occupancy gauges. The
+        boundary's wall time becomes one ``chunk_ms`` observation and —
+        with tracing on — one per-resident-slot complete event (the
+        per-slot host mirrors say which slots were mid-prefill vs
+        decoding; the duration is the shared batched scan's, because the
+        per-slot split does not exist on the device)."""
         if wd is not None:
             wd.beat("decode chunk")
         self._maybe_drain(guard)
         occupied = self.engine.active_count
+        infos = self.engine.slot_info() if self.trace.enabled else ()
+        t0 = self._clock()
         finished = self.engine.step()
+        dt = self._clock() - t0
         with self._stats_lock:
-            self.stats["chunks"] += 1
-            self.stats["slot_steps_active"] += occupied
-            self.stats["slot_steps_total"] += self.engine.slots
+            self._bump("chunks")
+            self._bump("slot_steps_active", occupied)
+            self._bump("slot_steps_total", self.engine.slots)
+            self._h_chunk_ms.observe(dt * 1e3)
+        for i, tag, phase, k in infos:
+            self.trace.complete(
+                "decode_chunk" if phase == "decode" else "prefill_piece",
+                t0, dt, req=getattr(tag, "rid", None), slot=i, chunk=k,
+            )
         for pending, result in finished:
             self._complete(pending, result)
 
@@ -615,6 +812,11 @@ class Server:
         self._bump(result.status)
         self._bump("rewinds", result.rewinds)
         self._bump("reprefills", result.reprefills)
+        if result.status == "failed":
+            # ladder exhaustion: one of the flight recorder's dump
+            # triggers — the black box must capture the rungs that led
+            # here before anything else scrolls them off
+            self.flight.dump("ladder-exhausted")
         if result.status == "failed" or result.degraded:
             self._degrade(
                 f"request needed the ladder (rewinds={result.rewinds}, "
@@ -622,15 +824,40 @@ class Server:
             )
         elif self.health.state is Health.DEGRADED:
             self.health.to(Health.SERVING, "clean request completed")
+        self._finalize(pending, result.status)
+
+    def _finalize(self, pending: Pending, status: str) -> None:
+        """The one place a Pending's done event fires: stamps done_at,
+        closes the request's trace span, releases the waiter, and runs
+        the ``on_done`` tap (the fleet router's root-span close)."""
         pending.done_at = self._clock()
+        self.trace.end("request", pending.rid, status=status,
+                       session=pending.request.session_id)
         pending.done.set()
+        cb = pending.on_done
+        if cb is not None:
+            try:
+                cb(pending)
+            except Exception:
+                pass  # telemetry must never break completion
 
     def occupancy(self) -> float:
-        """Fraction of slot-chunks that carried a live request (1.0 =
-        perfectly packed) — the continuous-batching utilization gauge."""
+        """INSTANTANEOUS slot utilization: the fraction of slots holding
+        a live request right now, straight from the engine's host-side
+        gauges. This is what a load balancer wants — the old behaviour
+        (a lifetime average that still read 0.9 on a server that went
+        idle an hour ago) lives on as :meth:`occupancy_lifetime`."""
+        occ = self.engine.occupancy()
+        return occ["active"] / occ["slots"] if occ["slots"] else 0.0
+
+    def occupancy_lifetime(self) -> float:
+        """Lifetime fraction of slot-chunks that carried a live request
+        (1.0 = perfectly packed) — the continuous-batching utilization
+        figure the serving bench reports."""
         with self._stats_lock:
-            total = self.stats["slot_steps_total"]
-            return self.stats["slot_steps_active"] / total if total else 0.0
+            flat = self.metrics.counters_flat()
+            total = flat.get("slot_steps_total", 0)
+            return flat.get("slot_steps_active", 0) / total if total else 0.0
 
     def snapshot(self) -> dict:
         """Health + scheduler gauges in one payload (the /healthz body).
@@ -643,13 +870,17 @@ class Server:
         with self._stats_lock:
             snap = self.health.snapshot()
             snap["stats"] = dict(self.stats)
-            snap["occupancy"] = self.occupancy()  # RLock: nested is fine
+            snap["occupancy"] = self.occupancy_lifetime()  # RLock: nested
+            snap["occupancy_now"] = self.occupancy()
             snap["slots"] = self.engine.occupancy()
             snap["sessions"] = {
                 "resident": len(self._sessions),
                 "in_slots": len(self._active_sessions),
             }
             snap["queued"] = self._q.qsize()
+            # the full registry rides along so a fleet supervisor can
+            # aggregate child registries over the existing status op
+            snap["metrics"] = self.metrics.snapshot()
         return snap
 
     def _maybe_drain(self, guard) -> None:
@@ -665,6 +896,11 @@ class Server:
         if self.health.state is Health.SERVING:
             self.health.to(Health.DEGRADED, reason)
 
+    def _on_wd(self, event: str, detail: str) -> None:
+        # watchdog tap: beats + stalls into the black box (the ring is
+        # bounded, so per-chunk beats are cheap context, not a leak)
+        self.flight.record("watchdog", event=event, detail=detail)
+
     def _on_stall(self, diag: str) -> None:
         # watchdog monitor thread, NOT a signal handler: buffered io is fine
         self._bump("stalls")
@@ -679,7 +915,8 @@ class Server:
                 return
             pending.error = RejectedError("server shut down before execution")
             self._bump("rejected")
-            pending.done.set()
+            self.trace.end("queue", pending.rid)
+            self._finalize(pending, "rejected")
 
 
 __all__ = [

@@ -56,6 +56,7 @@ from orion_tpu.models.transformer import (
     decode_state_finite,
     snapshot_decode_state,
 )
+from orion_tpu.obs import flight
 from orion_tpu.resilience.inject import decode_nan_armed, fire
 
 Array = jax.Array
@@ -181,15 +182,20 @@ class DecodeSession:
             return carry, toks, 0, 0
         # rung 1: rewind to the last finite boundary snapshot and redo —
         # transient corruption (injected fault, bit flip) won't recur
+        # (each rung leaves a black-box event: the solo session feeds the
+        # process-default flight ring, obs/flight.py)
+        flight.record("ladder", rung="rewind", chunk=chunk_idx)
         carry, toks = self._attempt(snap, rng, n, n_steps, sample, chunk_idx)
         if self._probe_finite(carry):
             return carry, toks, 1, 0
         # rung 2: the snapshot itself may be poisoned — rebuild the state
         # from the tokens, the one thing known good (they were emitted)
+        flight.record("ladder", rung="reprefill", chunk=chunk_idx)
         fresh = self._reprefill(prompt, emitted, n, sample, rng)
         carry, toks = self._attempt(fresh, rng, n, n_steps, sample, chunk_idx)
         if self._probe_finite(carry):
             return carry, toks, 1, 1
+        flight.record("ladder", rung="exhausted", chunk=chunk_idx)
         raise LadderExhausted(
             f"decode state non-finite at chunk {chunk_idx} after rewind "
             "and re-prefill; failing the request"

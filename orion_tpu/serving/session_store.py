@@ -44,6 +44,7 @@ import dataclasses
 import json
 import os
 import re
+import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -176,13 +177,28 @@ class SessionStore:
         keep: int = 2,
         retry: Optional[RetryPolicy] = None,
         should_abort: Optional[Callable[[], bool]] = None,
+        observer: Optional[Callable[[str, float], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         assert keep >= 1, keep
         self.directory = os.path.abspath(directory)
         self.keep = int(keep)
         self._retry = retry if retry is not None else RetryPolicy()
         self._should_abort = should_abort
+        # telemetry tap: ("save"|"load", elapsed_ms) after each completed
+        # operation, retries and verification included — the Server feeds
+        # its session_save_ms/session_load_ms histograms from here. Must
+        # be host-only (obs-device-sync covers registered hooks).
+        self._observer = observer
+        self._clock = clock
         os.makedirs(self.directory, exist_ok=True)
+
+    def _observe(self, op: str, t0: float) -> None:
+        if self._observer is not None:
+            try:
+                self._observer(op, (self._clock() - t0) * 1e3)
+            except Exception:
+                pass  # telemetry must never fail the I/O it measures
 
     # -- paths ----------------------------------------------------------------
 
@@ -276,11 +292,13 @@ class SessionStore:
             os.replace(tmp, self._bin(d, gen))
             atomic_write_json(self._json(d, gen), doc)  # commit point
 
+        t0 = self._clock()
         call_with_retries(
             _write, self._retry,
             describe=f"session save ({state.session_id} gen {gen})",
             should_abort=self._should_abort,
         )
+        self._observe("save", t0)
         state.generation = gen
         self._gc(d, keep_from=gen)
         return gen
@@ -317,6 +335,7 @@ class SessionStore:
         gens = self.generations(session_id)
         if not gens:
             return None
+        t0 = self._clock()
         failures: List[Tuple[int, Exception]] = []
         for gen in reversed(gens):
             try:
@@ -336,6 +355,7 @@ class SessionStore:
                     f"after skipping {[g for g, _ in failures]}",
                     stacklevel=2,
                 )
+            self._observe("load", t0)
             return state
         raise SessionIntegrityError(
             f"no intact generation for session {session_id}; tried "

@@ -37,6 +37,7 @@ def train(
     eval_data: Optional[str] = None,
     log_path: Optional[str] = None,
     resume: bool = True,
+    metrics_path: Optional[str] = None,
 ) -> Tuple[object, dict]:
     """Build everything, optionally resume, run to cfg.steps. Returns
     (final TrainState, last metrics dict)."""
@@ -72,6 +73,15 @@ def train(
         stall_timeout=cfg.step_timeout if cfg.step_timeout > 0 else None,
     )
     logger = MetricsLogger(log_path)
+    if cfg.ckpt_dir:
+        # the run directory doubles as the black box's dump target: a
+        # preemption or nan-halt leaves flight-*.json beside the
+        # checkpoints it force-saved (obs/flight.py)
+        import os as _os
+
+        from orion_tpu.obs import flight as _flight
+
+        _flight.configure(dump_dir=_os.path.join(cfg.ckpt_dir, "flight"))
     eval_factory = None
     if cfg.eval_every:
         # a real held-out split when given (--eval-data val.bin); otherwise
@@ -118,8 +128,19 @@ def train(
     # SIGTERM/SIGINT graceful-stop guard for the duration of the run;
     # step_timeout > 0 arms the hang watchdog (the loader's stall detector
     # is wired above with the same budget)
+    from orion_tpu.obs import flight as _fl
+
     guard_cm = (
-        PreemptionGuard(cfg.preempt_grace)
+        PreemptionGuard(
+            cfg.preempt_grace,
+            # signal-context tap: the black box records the signal the
+            # instant it lands (lock-free append — the handler runs
+            # between two arbitrary bytecodes), not just the boundary
+            # where the trainer later acts on it
+            on_stop=lambda signum: _fl.recorder().record_signal_safe(
+                "preempt_signal", signum=signum
+            ),
+        )
         if cfg.preempt_grace > 0
         else contextlib.nullcontext()
     )
@@ -148,6 +169,13 @@ def train(
         if watchdog is not None:
             watchdog.close()
         loader.close()
+        if metrics_path:
+            # final scrape on every exit path (same contract as the
+            # serving CLI's on-drain dump): Prometheus text + .json
+            try:
+                logger.dump(metrics_path)
+            except OSError as e:
+                print(f"metrics dump failed: {e}", file=sys.stderr)
         logger.close()
         if ckpt is not None:
             # close() waits for any in-flight async save, INCLUDING on the
@@ -172,6 +200,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--log-path", default=None)
+    p.add_argument("--metrics-path", default=None,
+                   help="Prometheus-text metrics exposition file "
+                        "(+ .json sibling), written on exit — the same "
+                        "registry format the serving/fleet CLIs expose")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
         "--preempt-grace", type=float, default=10.0,
@@ -237,7 +269,8 @@ def main(argv=None) -> int:
             cfg, model=dataclasses.replace(cfg.model, max_seq_len=cfg.seq_len + 1)
         )
     _, last = train(
-        cfg, data=args.data, eval_data=args.eval_data, log_path=args.log_path
+        cfg, data=args.data, eval_data=args.eval_data,
+        log_path=args.log_path, metrics_path=args.metrics_path,
     )
     print({k: round(v, 5) for k, v in last.items()})
     return 0

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from orion_tpu.models.configs import ModelConfig
 from orion_tpu.models.transformer import TransformerLM, _dtype
+from orion_tpu.obs import flight as _flight
 from orion_tpu.parallel.mesh import MeshConfig, make_mesh
 from orion_tpu.parallel.sharding import batch_sharding, param_shardings
 from orion_tpu.resilience import inject as _inject
@@ -812,8 +813,14 @@ class Trainer:
                 # that happened *between* log points too
                 nf_total = int(metrics["nonfinite_total"])
                 if nf_total > self.nonfinite_steps:
+                    # black-box the non-finite step window (the flight
+                    # recorder is the training run's post-mortem ring,
+                    # same spine as serving's — obs/flight.py)
+                    _flight.record("train_nonfinite", step=step,
+                                   total=nf_total)
                     self.nonfinite_steps = nf_total
                     if cfg.nan_policy == "halt":
+                        _flight.recorder().dump("train-nan-halt")
                         # emergency checkpoint BEFORE halting: the offending
                         # state must be post-mortem restorable (params are
                         # the pre-skip values, counter included)
@@ -875,6 +882,9 @@ class Trainer:
                     ckpt.maybe_save(step, self.state, force=True)
                     ckpt.wait()
                 self.preempted_at = step
+                _flight.record("train_preempt", step=step,
+                               signum=getattr(preempt, "signum", None))
+                _flight.recorder().dump("train-preempt")
                 if not last:
                     last = {k: float(v) for k, v in metrics.items()}
                 break

@@ -362,6 +362,52 @@ def append_log(path, line):
 """,
     ),
     (
+        "obs-device-sync",
+        "orion_tpu/obs/dummy.py",
+        """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def scrape(state):
+    v = float(state.sum())
+    state.block_until_ready()
+    return np.asarray(state), v, int(jnp.max(state))
+""",
+        """
+import json
+import threading
+
+def scrape(registry):
+    with registry._lock:
+        return json.dumps(dict(registry._counters))
+
+def record(ring, kind, value):
+    ring.append((kind, value))  # host numbers in, host numbers out
+""",
+    ),
+    (
+        "obs-device-sync",
+        "orion_tpu/serving/obs_hooks_dummy.py",
+        """
+def slot_gauge(engine):
+    return float(engine.state.sum())  # device sync inside a gauge fn
+
+def wire(registry, engine):
+    registry.gauge_fn("slots_active", slot_gauge)
+""",
+        """
+def slot_gauge(engine):
+    return engine.active_count  # the host mirror, already an int
+
+def wire(registry, engine):
+    registry.gauge_fn("slots_active", slot_gauge)
+
+def host_eval(x):
+    return float(x)  # NOT registered as a hook: plain host code is fine
+""",
+    ),
+    (
         "non-atomic-persist",
         "orion_tpu/resilience/dummy.py",
         """
@@ -482,6 +528,77 @@ def poll(worker):
     )
     assert "unbounded-wait" in rule_ids(
         lint_source(src, path="orion_tpu/training/dummy.py")
+    )
+
+
+def test_obs_device_sync_covers_hook_registration_forms():
+    """Every way a callable enters the telemetry spine — hook keywords
+    (on_event/on_transition/observer/...), ``add_observer``, and
+    ``pending.on_done = fn`` assignment — marks that function's body as
+    a hot-path hook: a device sync inside is a finding; the same code
+    unregistered is not."""
+    kw = """
+def on_health(old, new, reason):
+    latency = float(new.state.sum())  # syncs on every transition
+    return latency
+
+def wire(machine):
+    machine.configure(on_transition=on_health)
+"""
+    assert "obs-device-sync" in rule_ids(
+        lint_source(kw, path="orion_tpu/serving/dummy.py")
+    )
+    assign = """
+def close_span(p):
+    p.result.tokens.block_until_ready()
+
+def attach(pending):
+    pending.on_done = close_span
+"""
+    assert "obs-device-sync" in rule_ids(
+        lint_source(assign, path="orion_tpu/fleet/dummy.py")
+    )
+    observer = """
+def on_fault(site, step):
+    import jax
+    jax.device_get(step)
+
+def wire(ring):
+    ring.add_observer(on_fault)
+"""
+    assert "obs-device-sync" in rule_ids(
+        lint_source(observer, path="orion_tpu/resilience/dummy.py")
+    )
+    # the identical body NOT registered anywhere stays un-flagged
+    free = """
+def on_health(old, new, reason):
+    latency = float(new.state.sum())
+    return latency
+"""
+    assert "obs-device-sync" not in rule_ids(
+        lint_source(free, path="orion_tpu/serving/dummy.py")
+    )
+    # and tests may do whatever they like
+    assert "obs-device-sync" not in rule_ids(
+        lint_source(kw, path="tests/test_dummy.py")
+    )
+
+
+def test_obs_device_sync_bans_jax_imports_in_obs_package():
+    """Inside orion_tpu/obs/ the jax IMPORT itself is the finding — a
+    device array must be structurally unreachable from telemetry code,
+    not just unpatterned; outside obs/ the import is of course fine."""
+    src = """
+from jax import numpy as jnp
+
+def fmt(v):
+    return str(v)
+"""
+    assert "obs-device-sync" in rule_ids(
+        lint_source(src, path="orion_tpu/obs/trace_dummy.py")
+    )
+    assert "obs-device-sync" not in rule_ids(
+        lint_source(src, path="orion_tpu/serving/dummy.py")
     )
 
 

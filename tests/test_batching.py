@@ -569,8 +569,12 @@ def test_server_occupancy_gauges(mp):
                                  seed=i))
     srv.serve(drain_when_idle=True)
     assert srv.stats["chunks"] >= 4
-    assert 0.0 < srv.occupancy() <= 1.0
+    # ISSUE 9 split: occupancy() is INSTANTANEOUS (0.0 on a drained
+    # engine); the lifetime packing average moved to occupancy_lifetime()
+    assert 0.0 < srv.occupancy_lifetime() <= 1.0
+    assert srv.occupancy() == 0.0, "no slot is live after the drain"
     snap = srv.snapshot()
     assert snap["slots"]["slots"] == 2 and snap["slots"]["active"] == 0
     assert snap["stats"]["ok"] == 3
+    assert snap["occupancy"] == srv.occupancy_lifetime()
     srv.close()

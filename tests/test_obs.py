@@ -1,0 +1,593 @@
+"""Telemetry-spine suite (ISSUE 9): metrics registry, request traces,
+flight recorder.
+
+The acceptance proofs live here — (1) a chaos run (staggered admission,
+mid-stream SIGTERM suspend, ladder rung 2, cross-replica resume) yields
+a trace whose spans pair begin/end for every request, whose chunk events
+nest inside their request's span, and whose resumed turn links to the
+original session id; (2) enabling FULL telemetry (metrics + trace +
+flight) adds zero decode/prefill compiles — the instrumentation is pure
+host bookkeeping at chunk boundaries; (3) the flight recorder dumps at
+every DEGRADED/ladder-exhaustion/drain trigger and its ring carries
+every fired fault-injection site. Plus registry/tracer/recorder unit
+coverage and the fleet-level aggregation over the status op.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.generate import (
+    SampleConfig,
+    _decode_batched_chunk_jit,
+    _decode_batched_prefill_chunk_jit,
+    _prefill_carry_bucketed_jit,
+    _prefill_carry_jit,
+    generate,
+)
+from orion_tpu.models.configs import ModelConfig
+from orion_tpu.models.transformer import TransformerLM
+from orion_tpu.obs.flight import FlightRecorder
+from orion_tpu.obs.metrics import (
+    MetricsRegistry,
+    aggregate,
+    prometheus_from_snapshot,
+)
+from orion_tpu.obs.trace import Tracer, merge_traces, read_jsonl, span_pairs
+from orion_tpu.resilience import inject
+from orion_tpu.serving import (
+    DecodeRequest,
+    Health,
+    ServeConfig,
+    Server,
+)
+
+pytestmark = pytest.mark.chaos
+
+CFG = ModelConfig(
+    name="obs_test", vocab_size=64, d_model=32, n_layers=3, n_heads=2,
+    layer_types=("linear", "softmax", "swa"), window=4, max_seq_len=96,
+    dtype="float32", backend="xla",
+)
+GREEDY = SampleConfig(temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = TransformerLM(CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _prompt(i, ln=5):
+    return jax.random.randint(
+        jax.random.PRNGKey(3000 + i), (1, ln), 0, CFG.vocab_size
+    ).astype(jnp.int32)
+
+
+def _ref(mp, prompt, n_new, sample, seed):
+    model, params = mp
+    return np.asarray(
+        generate(model, params, prompt, n_new, sample,
+                 rng=jax.random.PRNGKey(seed))
+    )
+
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("chunk", 4)
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_inflight", 8)
+    return ServeConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counters_gauges_histograms_and_prometheus():
+    now = [0.0]
+    r = MetricsRegistry(clock=lambda: now[0])
+    r.counter("ok").inc()
+    r.counter("ok").inc(2)
+    r.counter("ladder_rungs").inc(labels={"rung": "rewind"})
+    r.gauge("depth").set(5)
+    r.gauge_fn("live", lambda: 7, labels={"cache": "decode"})
+    h = r.histogram("lat_ms", buckets=(1, 10, 100))
+    for v in (0.5, 10, 5000):
+        h.observe(v)
+    assert r.counters_flat()["ok"] == 3
+    snap = r.snapshot()
+    gauges = {(g["name"], tuple(sorted(g["labels"].items()))): g["value"]
+              for g in snap["gauges"]}
+    assert gauges[("depth", ())] == 5
+    assert gauges[("live", (("cache", "decode"),))] == 7
+    (hist,) = snap["histograms"]
+    assert hist["count"] == 3 and hist["counts"] == [1, 1, 0, 1]
+    assert hist["buckets"][-1] == "+Inf"
+    text = r.to_prometheus()
+    assert "# TYPE ok counter" in text and "ok 3" in text
+    assert 'ladder_rungs{rung="rewind"} 1' in text
+    assert 'lat_ms_bucket{le="+Inf"} 3' in text and "lat_ms_count 3" in text
+    # snapshot is JSON-clean (the status-op wire format)
+    json.dumps(snap)
+
+
+def test_registry_snapshot_is_one_consistent_read():
+    """Callable gauges evaluate INSIDE the same lock acquisition as the
+    counter read — a scrape can't see gauge state from after a counter
+    bump it didn't see."""
+    r = MetricsRegistry()
+    c = r.counter("events")
+
+    def gauge_from_counter():
+        # runs under the registry lock: reads the same cells the
+        # snapshot serializes
+        return r._counters["events"].get((), 0)
+
+    r.gauge_fn("events_gauge", gauge_from_counter)
+    c.inc(41)
+    snap = r.snapshot()
+    counter = [x for x in snap["counters"] if x["name"] == "events"][0]
+    gauge = [x for x in snap["gauges"] if x["name"] == "events_gauge"][0]
+    assert counter["value"] == gauge["value"] == 41
+
+
+def test_registry_dump_and_aggregate(tmp_path):
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for r, n in ((a, 2), (b, 3)):
+        r.counter("ok").inc(n)
+        r.gauge("queue_depth").set(n)
+        r.histogram("ms", buckets=(1, 10)).observe(n)
+    agg = aggregate([a.snapshot(), b.snapshot()], sources=["r0", "r1"])
+    rows = {row["name"]: row for row in agg["counters"]}
+    assert rows["ok"]["value"] == 5
+    grows = {row["name"]: row for row in agg["gauges"]}
+    assert grows["queue_depth"]["value"] == 5  # gauges sum across replicas
+    hrow = agg["histograms"][0]
+    assert hrow["count"] == 2 and hrow["sum"] == 5
+    assert agg["sources"] == ["r0", "r1"]
+    text = prometheus_from_snapshot(agg)
+    assert "ok 5" in text
+    path = str(tmp_path / "m" / "metrics.prom")
+    a.dump(path)
+    assert os.path.exists(path) and os.path.exists(path + ".json")
+    with open(path + ".json") as f:
+        assert json.load(f)["counters"][0]["value"] == 2
+
+
+def test_obs_package_never_imports_jax():
+    """The structural half of obs-device-sync: the spine's modules are
+    importable (and import-clean) with no jax dependency edge."""
+    import sys
+
+    for mod in ("metrics", "trace", "flight"):
+        src = open(os.path.join(
+            os.path.dirname(sys.modules["orion_tpu.obs"].__file__),
+            f"{mod}.py",
+        )).read()
+        assert "import jax" not in src, mod
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_span_pairing_flush_and_merge(tmp_path):
+    path = str(tmp_path / "t" / "a.jsonl")
+    now = [1.0]
+    tr = Tracer(path=path, clock=lambda: now[0])
+    tr.begin("request", "req-1", session="conv")
+    now[0] = 1.01
+    tr.complete("decode_chunk", 1.005, 0.004, req="req-1", slot=0, chunk=0)
+    tr.instant("ladder", id="req-1", rung="rewind")
+    now[0] = 1.02
+    tr.end("request", "req-1", status="ok")
+    assert tr.flush() == 4
+    events = read_jsonl(path)
+    assert [e["ph"] for e in events] == ["b", "X", "i", "e"]
+    pairs = span_pairs(events)
+    assert len(pairs[("request", "req-1", "request")]["b"]) == 1
+    assert len(pairs[("request", "req-1", "request")]["e"]) == 1
+    x = events[1]
+    assert x["dur"] == pytest.approx(4000) and x["args"]["slot"] == 0
+    # a second process's file concatenates + merges into Perfetto shape
+    path2 = str(tmp_path / "t" / "b.jsonl")
+    tr2 = Tracer(path=path2, clock=lambda: 2.0)
+    tr2.begin("turn", "conv:1", cat="fleet", session="conv")
+    tr2.end("turn", "conv:1", cat="fleet", status="ok")
+    tr2.flush()
+    out = str(tmp_path / "t" / "merged.json")
+    n = merge_traces([path, path2, str(tmp_path / "missing.jsonl")], out)
+    assert n == 6
+    with open(out) as f:
+        doc = json.load(f)
+    assert set(doc) >= {"traceEvents", "displayTimeUnit"}
+    assert len(doc["traceEvents"]) == 6
+    ts = [e["ts"] for e in doc["traceEvents"]]
+    assert ts == sorted(ts), "merged events must be time-ordered"
+
+
+def test_tracer_disabled_is_inert_and_ring_is_bounded():
+    tr = Tracer(path=None, enabled=False)
+    tr.begin("request", "x")
+    assert tr.events() == []
+    small = Tracer(path=None, capacity=4)
+    for i in range(10):
+        small.instant("e", i=i)
+    assert len(small.events()) == 4 and small.dropped == 6
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounded_dump_and_triggers(tmp_path):
+    now = [5.0]
+    rec = FlightRecorder(capacity=3, clock=lambda: now[0],
+                         dump_dir=str(tmp_path / "fl"))
+    for i in range(5):
+        rec.record("beat", i=i)
+    evs = rec.events()
+    assert [e["i"] for e in evs] == [2, 3, 4] and rec.dropped == 2
+    p1 = rec.dump("health-degraded")
+    now[0] = 6.0
+    rec.record("beat", i=99)
+    p2 = rec.dump("health-degraded")
+    assert p1 != p2, "each trigger writes its OWN file"
+    # a SECOND recorder (another replica) dumping the same reason into
+    # the same dir must not clobber the first one's files
+    other = FlightRecorder(dump_dir=str(tmp_path / "fl"))
+    other.record("beat", i=-1)
+    p3 = other.dump("health-degraded")
+    assert p3 not in (p1, p2)
+    assert os.path.exists(p1) and os.path.exists(p2)
+    with open(p2) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "health-degraded" and doc["dropped"] == 3
+    assert doc["events"][-1]["i"] == 99
+    # no dump_dir -> ring only, dump is a no-op
+    assert FlightRecorder().dump("x") is None
+
+
+def test_flight_subscribes_to_inject_deliveries():
+    rec = FlightRecorder()
+    rec.attach_inject()
+    try:
+        plan = inject.FaultPlan().add("serve.chunk", step=3)
+        with inject.inject(plan):
+            inject.fire("serve.chunk", step=2)  # not armed: no delivery
+            inject.fire("serve.chunk", step=3)
+    finally:
+        rec.detach_inject()
+    faults = rec.events("fault")
+    assert [(e["site"], e["step"]) for e in faults] == [("serve.chunk", 3)]
+    # detached: further deliveries leave no event
+    with inject.inject(inject.FaultPlan().add("serve.chunk")):
+        inject.fire("serve.chunk", step=0)
+    assert len(rec.events("fault")) == 1
+
+
+# ---------------------------------------------------------------------------
+# server migration: stats contract, new gauges, occupancy split
+# ---------------------------------------------------------------------------
+
+
+def test_server_stats_ride_the_registry(mp, tmp_path):
+    model, params = mp
+    srv = Server(model, params, _cfg(tmp_path))
+    for i in range(3):
+        srv.submit(DecodeRequest(prompt=_prompt(i), max_new_tokens=8,
+                                 sample=GREEDY, seed=i))
+    assert srv.serve(drain_when_idle=True) == 0
+    # the PR 4-8 dict contract, now registry-backed
+    assert srv.stats["ok"] == 3 and srv.stats["admitted"] == 3
+    snap = srv.snapshot()
+    assert snap["stats"]["ok"] == 3
+    # the new gauges we used to fly blind on
+    m = snap["metrics"]
+    gauges = {(g["name"], tuple(sorted(g["labels"].items()))): g["value"]
+              for g in m["gauges"]}
+    assert gauges[("queue_depth", ())] == 0
+    assert gauges[("slots", (("state", "active"),))] == 0
+    assert gauges[("slots", (("state", "free"),))] == 2
+    caches = [g for g in m["gauges"] if g["name"] == "compile_cache_entries"]
+    assert {g["labels"]["cache"] for g in caches} == {
+        "decode_batched", "unified_prefill", "prefill", "prefill_bucketed",
+    }
+    assert any(g["value"] > 0 for g in caches), "the engine compiled SOMETHING"
+    hists = {h["name"]: h for h in m["histograms"]}
+    assert hists["chunk_ms"]["count"] == srv.stats["chunks"] > 0
+    text = srv.metrics.to_prometheus()
+    assert "# TYPE ok counter" in text and "chunk_ms_bucket" in text
+    srv.close()
+
+
+def test_occupancy_instantaneous_vs_lifetime(mp, tmp_path):
+    model, params = mp
+    srv = Server(model, params, _cfg(tmp_path))
+    assert srv.occupancy() == 0.0 and srv.occupancy_lifetime() == 0.0
+    seen = []
+    real_step = srv.engine.step
+
+    def spying_step():
+        seen.append(srv.occupancy())  # mid-run: slots ARE live
+        return real_step()
+
+    srv.engine.step = spying_step
+    srv.submit(DecodeRequest(prompt=_prompt(0), max_new_tokens=8,
+                             sample=GREEDY, seed=0))
+    assert srv.serve(drain_when_idle=True) == 0
+    srv.engine.step = real_step
+    assert seen and max(seen) == 0.5, "1 of 2 slots live mid-run"
+    assert srv.occupancy() == 0.0, "instantaneous: drained engine is empty"
+    assert 0.0 < srv.occupancy_lifetime() <= 1.0
+    srv.close()
+
+
+def test_session_store_latency_histograms(mp, tmp_path):
+    model, params = mp
+    cfg = _cfg(tmp_path, session_dir=str(tmp_path / "s"))
+    srv1 = Server(model, params, cfg)
+    srv1.submit(DecodeRequest(prompt=_prompt(0), max_new_tokens=8,
+                              sample=GREEDY, seed=0, session_id="conv"))
+    assert srv1.serve(drain_when_idle=True) == 0
+    assert srv1._h_session_save_ms.cell()["count"] >= 1
+    srv1.close()
+    srv2 = Server(model, params, cfg)  # fresh replica: resume hits disk
+    srv2.submit(DecodeRequest(prompt=np.zeros((1, 0), np.int32),
+                              max_new_tokens=4, sample=GREEDY, seed=0,
+                              session_id="conv"))
+    assert srv2.serve(drain_when_idle=True) == 0
+    assert srv2._h_session_load_ms.cell()["count"] >= 1
+    srv2.close()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance chaos run: staggered admission, ladder rung 2, SIGTERM
+# suspend, cross-replica resume — complete span pairing, nested chunks,
+# session-linked turns, flight dumps at every trigger
+# ---------------------------------------------------------------------------
+
+
+def _request_spans(events):
+    return {
+        key: v for key, v in span_pairs(events).items()
+        if key[2] == "request"
+    }
+
+
+def test_chaos_run_trace_complete_and_flight_dumps(mp, tmp_path):
+    model, params = mp
+    want = 24
+    trace_path = str(tmp_path / "trace.jsonl")
+    flight_dir = str(tmp_path / "flight")
+    tracer = Tracer(path=trace_path, clock=time.monotonic)
+    cfg = _cfg(tmp_path, session_dir=str(tmp_path / "s"),
+               flight_dir=flight_dir,
+               metrics_path=str(tmp_path / "metrics.prom"),
+               metrics_interval_s=0.0)
+    sid = "conv"
+    refs = {
+        "sess": _ref(mp, _prompt(0), want, GREEDY, seed=7),
+        "plain": _ref(mp, _prompt(1, ln=4), 16, GREEDY, seed=8),
+    }
+    # ---- replica 1: two staggered admissions (different lengths →
+    # different in-scan staging walks). The SESSIONLESS request (slot 1)
+    # is poisoned twice at its chunk 2, so it walks ladder rung 2 and
+    # COMPLETES degraded before the drain (SERVING -> DEGRADED fires its
+    # dump); SIGTERM at boundary 4 then suspends the session MID-stream
+    # while the plain request has already drained to completion.
+    srv1 = Server(model, params, cfg, tracer=tracer)
+    p_sess = srv1.submit(DecodeRequest(
+        prompt=_prompt(0), max_new_tokens=want, sample=GREEDY, seed=7,
+        session_id=sid,
+    ))
+    p_plain = srv1.submit(DecodeRequest(
+        prompt=_prompt(1, ln=4), max_new_tokens=16, sample=GREEDY, seed=8,
+    ))
+    plan = (
+        inject.FaultPlan()
+        .poison_decode_slot_at(1, 2, times=2)
+        .preempt_at_chunk(4)
+    )
+    with inject.inject(plan):
+        rc = srv1.serve()
+    assert rc == 0 and srv1.health.state is Health.DEAD
+    assert p_sess.result is not None and p_sess.result.status == "suspended"
+    assert 0 < p_sess.result.new_tokens < want
+    assert p_plain.result is not None and p_plain.result.status == "ok"
+    np.testing.assert_array_equal(p_plain.result.tokens, refs["plain"])
+    # metrics exposition happened on drain (interval 0 = on-drain only);
+    # checked before replica 2 rewrites the scrape with its own registry
+    assert os.path.exists(cfg.metrics_path)
+    assert "ladder_rungs" in open(cfg.metrics_path).read()
+    # ---- replica 2 (fresh server over the same store + tracer file):
+    # the resumed turn must link to the original conversation
+    import dataclasses
+
+    cfg2 = dataclasses.replace(
+        cfg, metrics_path=str(tmp_path / "metrics2.prom")
+    )
+    srv2 = Server(model, params, cfg2, tracer=tracer)
+    p_cont = srv2.submit(DecodeRequest(
+        prompt=np.zeros((1, 0), np.int32),
+        max_new_tokens=want - p_sess.result.new_tokens,
+        sample=GREEDY, seed=0, session_id=sid,
+    ))
+    assert srv2.serve(drain_when_idle=True) == 0
+    assert p_cont.result.status == "ok"
+    np.testing.assert_array_equal(
+        np.concatenate([p_sess.result.tokens, p_cont.result.tokens], axis=1),
+        refs["sess"],
+    )
+    srv2.close()
+
+    # ---- trace assertions ----
+    events = read_jsonl(trace_path)
+    req_spans = _request_spans(events)
+    assert len(req_spans) == 3, "three requests -> three request spans"
+    for key, pair in span_pairs(events).items():
+        assert len(pair["b"]) == len(pair["e"]) == 1, (
+            f"span {key} must pair begin/end exactly once"
+        )
+    # chunk events nest inside their request's span
+    by_rid = {key[1]: pair for key, pair in req_spans.items()}
+    chunk_events = [e for e in events if e["ph"] == "X"]
+    assert chunk_events, "chunk boundaries must leave complete events"
+    for ev in chunk_events:
+        rid = ev["args"]["req"]
+        assert rid in by_rid, f"chunk event {ev} orphaned from any request"
+        b = by_rid[rid]["b"][0]
+        e = by_rid[rid]["e"][0]
+        assert b["ts"] <= ev["ts"] and ev["ts"] + ev["dur"] <= e["ts"], (
+            "chunk events must nest inside their request span"
+        )
+    # both prefill and decode phases appear (in-scan staging is on)
+    assert {e["name"] for e in chunk_events} >= {
+        "prefill_piece", "decode_chunk",
+    }
+    # the resumed turn links to the original session id, across servers
+    sess_spans = [
+        key for key in req_spans if key[1].startswith(f"{sid}:")
+    ]
+    assert len(sess_spans) == 2, "turn 1 + resumed turn, one conversation"
+    for key in sess_spans:
+        assert req_spans[key]["b"][0]["args"]["session"] == sid
+    # ladder rungs are visible as instants tied to the poisoned request
+    ladder = [e for e in events if e["name"] == "ladder"]
+    assert ladder and all(e["args"]["rung"] for e in ladder)
+
+    # ---- flight-recorder assertions ----
+    # filenames are flight-<recorder token>-<seq>-<reason>.json: the
+    # token keeps replicas sharing one dump_dir from clobbering each
+    # other's black boxes
+    dumps = sorted(os.listdir(flight_dir))
+    reasons = {d.split("-", 3)[3].rsplit(".", 1)[0] for d in dumps}
+    assert {"health-degraded", "health-draining", "health-dead"} <= reasons, (
+        f"every trigger must dump: {dumps}"
+    )
+    # the drain dump carries every fired fault site (site⇄event parity)
+    drain_dump = [d for d in dumps if "health-draining" in d][0]
+    with open(os.path.join(flight_dir, drain_dump)) as f:
+        doc = json.load(f)
+    fault_sites = {e["site"] for e in doc["events"] if e["kind"] == "fault"}
+    assert fault_sites >= {"decode.slot_nan.1", "serve.chunk"}, (
+        "fired injection sites must appear in the black box"
+    )
+    kinds = {e["kind"] for e in doc["events"]}
+    assert {"admit", "ladder", "health"} <= kinds
+
+
+def test_ladder_exhaustion_dumps_flight(mp, tmp_path):
+    model, params = mp
+    cfg = _cfg(tmp_path, flight_dir=str(tmp_path / "fl"))
+    srv = Server(model, params, cfg)
+    srv.submit(DecodeRequest(prompt=_prompt(0), max_new_tokens=8,
+                             sample=GREEDY, seed=0))
+    plan = inject.FaultPlan().poison_decode_slot_at(0, 1, times=-1)
+    with inject.inject(plan):
+        assert srv.serve(drain_when_idle=True) == 0
+    assert srv.stats["failed"] == 1
+    dumps = os.listdir(str(tmp_path / "fl"))
+    assert any("ladder-exhausted" in d for d in dumps), dumps
+    exhausted = [e for e in srv.flight.events("ladder")
+                 if e["rung"] == "exhausted"]
+    assert exhausted, "the exhausted rung must be in the ring"
+    srv.close()
+
+
+def test_full_telemetry_adds_zero_compiles(mp, tmp_path):
+    """The acceptance cache-stat: a warmed engine shape re-served with
+    metrics + tracing + flight fully on leaves every decode/prefill jit
+    cache EXACTLY as the dark run left it — telemetry is host
+    bookkeeping, never a new program."""
+    model, params = mp
+
+    def run(cfg, tracer=None):
+        srv = Server(model, params, cfg, tracer=tracer)
+        for i in range(3):
+            srv.submit(DecodeRequest(prompt=_prompt(i, ln=3 + i),
+                                     max_new_tokens=12, sample=GREEDY,
+                                     seed=i))
+        assert srv.serve(drain_when_idle=True) == 0
+        assert srv.stats["ok"] == 3
+        srv.close()
+        return srv
+
+    dark = _cfg(tmp_path)
+    run(dark)  # warm every compile this shape needs
+    sizes = lambda: (  # noqa: E731
+        _decode_batched_chunk_jit._cache_size(),
+        _decode_batched_prefill_chunk_jit._cache_size(),
+        _prefill_carry_jit._cache_size(),
+        _prefill_carry_bucketed_jit._cache_size(),
+    )
+    before = sizes()
+    lit = _cfg(
+        tmp_path,
+        metrics_path=str(tmp_path / "m.prom"), metrics_interval_s=0.1,
+        trace_path=str(tmp_path / "t.jsonl"),
+        flight_dir=str(tmp_path / "fl2"),
+    )
+    srv = run(lit, tracer=Tracer(path=str(tmp_path / "t.jsonl"),
+                                 clock=time.monotonic))
+    assert sizes() == before, "telemetry must add ZERO compiles"
+    # and the telemetry actually ran — this wasn't a dark pass
+    assert read_jsonl(str(tmp_path / "t.jsonl"))
+    assert srv._h_chunk_ms.cell()["count"] > 0
+
+
+# ---------------------------------------------------------------------------
+# fleet: aggregated status over the control channel
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_aggregates_child_registries_and_roots_spans(mp, tmp_path):
+    from orion_tpu.fleet.replica import LocalReplica
+    from orion_tpu.fleet.supervisor import Supervisor
+
+    model, params = mp
+    tracer = Tracer(path=None, clock=time.monotonic)
+
+    def factory(name):
+        return LocalReplica(model, params, _cfg(tmp_path), name=name).start()
+
+    sup = Supervisor(factory, 2, tracer=tracer).start()
+    try:
+        pendings = [
+            sup.router.submit(DecodeRequest(
+                prompt=_prompt(i), max_new_tokens=8, sample=GREEDY, seed=i,
+            ))
+            for i in range(4)
+        ]
+        for p in pendings:
+            assert p.wait(timeout=60.0) is not None
+        agg = sup.aggregate_metrics()
+        rows = {row["name"]: row["value"] for row in agg["counters"]
+                if not row["labels"]}
+        assert rows["ok"] == 4, "fleet view sums child registries"
+        assert agg["replicas"] == 2 and len(agg["by_source"]) == 2
+        # per-replica breakdown rides along for the drill-down
+        per = {
+            name: {c["name"]: c["value"] for c in snap["counters"]
+                   if not c["labels"]}
+            for name, snap in agg["by_source"].items()
+        }
+        assert sum(d.get("ok", 0) for d in per.values()) == 4
+    finally:
+        sup.drain_all(timeout=30.0)
+    # the router opened (and closed) one root span per dispatched turn
+    pairs = {k: v for k, v in span_pairs(tracer.events()).items()
+             if k[2] == "turn"}
+    assert len(pairs) == 4
+    for key, pair in pairs.items():
+        assert len(pair["b"]) == len(pair["e"]) == 1, key
+        assert pair["e"][0]["args"]["status"] == "ok"

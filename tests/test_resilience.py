@@ -185,6 +185,38 @@ def test_every_registered_chaos_site_is_exercised():
         )
 
 
+def test_every_registered_site_delivery_leaves_flight_event():
+    """Site⇄event parity (ISSUE 9): EVERY registered injection site —
+    static names and dynamic prefix families alike — must leave a
+    ``fault`` event in an attached flight recorder when it delivers. An
+    injected fault that leaves no black-box trace is a finding: the
+    whole point of the recorder is that the post-mortem shows what was
+    armed when the incident fired. Parity is enforced at the delivery
+    layer (inject._take notifies observers), so a NEW site is covered
+    the moment it exists — this loop is generated from the registry,
+    never hand-listed."""
+    from orion_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder()
+    rec.attach_inject()
+    try:
+        sites = list(inject.SITES) + [
+            prefix + "0" for prefix in inject.SITE_PREFIXES
+        ]
+        for site in sites:
+            plan = inject.FaultPlan().add(site, times=1)
+            with inject.inject(plan):
+                inject.fire(site, step=0)
+            assert plan.delivered, site
+    finally:
+        rec.detach_inject()
+    seen = {e["site"] for e in rec.events("fault")}
+    assert seen == set(sites), (
+        f"sites that delivered without a flight event: "
+        f"{set(sites) - seen}"
+    )
+
+
 def test_watchdog_manual_fake_clock():
     now = [0.0]
     wd = Watchdog(timeout=5.0, clock=lambda: now[0], monitor=False,
