@@ -336,14 +336,16 @@ def _serve_trace(n_requests: int, rate_per_s: float, seed: int = 0):
 
 
 def _serve_one_trace(model, params, slots, chunk, arrivals, prompt, sample,
-                     max_new, warm: bool, obs_dir=None):
+                     max_new, warm: bool, obs_dir=None, scrape_ms=None):
     """One timed pass of the arrival trace through a fresh Server at the
     given slot count; returns the metrics row. ``warm``: run one
     throwaway request first so prefill/scan compiles stay out of the
     timed window. ``obs_dir``: turn FULL telemetry on (metrics registry
     dumping periodically, request tracing to JSONL, flight recorder with
     a dump dir) — the obs_overhead row runs the same trace with and
-    without it."""
+    without it. ``scrape_ms``: serve the LIVE /metrics endpoint
+    (ephemeral port) and scrape it every that-many ms from a client
+    thread for the whole pass — the slo_scrape row's ON configuration."""
     import threading
 
     from orion_tpu.serving import DecodeRequest, ServeConfig, Server
@@ -364,12 +366,30 @@ def _serve_one_trace(model, params, slots, chunk, arrivals, prompt, sample,
             flight_dir=os.path.join(obs_dir, "flight"),
         )
         tracer = Tracer(path=obs_kw["trace_path"], clock=time.monotonic)
+    if scrape_ms is not None:
+        obs_kw["metrics_port"] = 0  # ephemeral; bound port on the server
     server = Server(
         model, params,
         ServeConfig(chunk=chunk, slots=slots, max_inflight=len(arrivals),
                     **obs_kw),
         tracer=tracer,
     )
+    scrape_stop, scrapes, scraper = threading.Event(), [0], None
+    if scrape_ms is not None:
+        import urllib.request
+
+        scrape_url = f"http://127.0.0.1:{server.http_port}/metrics"
+
+        def scrape_loop():
+            while not scrape_stop.wait(scrape_ms / 1000.0):
+                try:
+                    with urllib.request.urlopen(scrape_url, timeout=2.0) as r:
+                        r.read()
+                    scrapes[0] += 1
+                except Exception:
+                    pass  # a missed scrape is the scraper's problem
+
+        scraper = threading.Thread(target=scrape_loop, daemon=True)
     if warm:
         warm_stop = _StopFlag()
         w = server.submit(DecodeRequest(
@@ -397,11 +417,22 @@ def _serve_one_trace(model, params, slots, chunk, arrivals, prompt, sample,
         stop.should_stop = True
 
     th = threading.Thread(target=feeder, daemon=True)
-    t_start = clock()
-    th.start()
-    server.serve(guard=stop)  # drains and returns once stop flips
-    wall = clock() - t_start
-    th.join(timeout=30)
+    if scraper is not None:
+        scraper.start()  # scraping spans the WHOLE timed window
+    try:
+        t_start = clock()
+        th.start()
+        server.serve(guard=stop)  # drains and returns once stop flips
+        wall = clock() - t_start
+        th.join(timeout=30)
+    finally:
+        if scraper is not None:
+            # even on a raising serve: stop the scraper and free the
+            # port, or later bench rows measure with a leaked scrape
+            # loop GETting an abandoned endpoint in the background
+            scrape_stop.set()
+            scraper.join(timeout=5.0)
+            server.close()
     lats = sorted(
         p.done_at - submitted for submitted, p in pendings
         if p.result is not None
@@ -429,6 +460,7 @@ def _serve_one_trace(model, params, slots, chunk, arrivals, prompt, sample,
             lats[min(len(lats) - 1, int(len(lats) * 0.99))], 4
         ) if lats else None,
         "occupancy": round(server.occupancy_lifetime(), 4),
+        **({"scrapes": scrapes[0]} if scrape_ms is not None else {}),
     }
 
 
@@ -534,6 +566,19 @@ def bench_serve(
     except Exception as e:
         out["obs_overhead_error"] = repr(e)
         print(json.dumps({"serve_obs_overhead_error": repr(e)}),
+              file=sys.stderr)
+    _free_device_memory()
+    try:
+        out["slo_scrape"] = bench_slo_scrape(
+            model, params, slots=slot_counts[-1], chunk=chunk,
+            n_requests=n_requests, max_new=max_new, prompt_len=prompt_len,
+            rate_per_s=rate_per_s, reps=reps,
+        )
+        print(json.dumps({"serve_slo_scrape": out["slo_scrape"]}),
+              file=sys.stderr)
+    except Exception as e:
+        out["slo_scrape_error"] = repr(e)
+        print(json.dumps({"serve_slo_scrape_error": repr(e)}),
               file=sys.stderr)
     _free_device_memory()
     return out
@@ -1030,6 +1075,101 @@ def bench_serve_adversarial(slots: int = 8, chunk: int = 16,
     return out
 
 
+def _paired_rounds(timed_pass, reps: int, max_rounds: int,
+                   floor_accept: float):
+    """PR 9's noise-calibrated pairing, shared by the obs_overhead and
+    slo_scrape rows: each rep runs off, on, off back-to-back (gc
+    discipline inside ``timed_pass``), scoring the on-pass against an
+    alternating off-neighbour; the (off, off) CONTROL ratio per rep
+    calibrates the box's noise floor. Re-rounds while the floor exceeds
+    ``floor_accept`` — selecting on the control, never on the estimate
+    itself. Returns (offs, ons, pair_overheads, pair_incl_drain,
+    control_fracs, rounds_run)."""
+
+    def one_round():
+        offs, ons = [], []
+        pair_overheads, pair_incl_drain, control_fracs = [], [], []
+        for rep in range(reps):
+            off_a = timed_pass(False)
+            on = timed_pass(True)
+            off_b = timed_pass(False)
+            # alternate which off-neighbour the on-pass is scored
+            # against, so within-rep decay doesn't always bill one side
+            off = off_a if rep % 2 == 0 else off_b
+            offs.append(off)
+            ons.append(on)
+            pair_overheads.append(
+                1.0 - on["tokens_per_sec_steady"]
+                / off["tokens_per_sec_steady"]
+            )
+            pair_incl_drain.append(
+                1.0 - on["tokens_per_sec"] / off["tokens_per_sec"]
+            )
+            # the zero-difference control: two identical dark passes
+            control_fracs.append(
+                1.0 - off_b["tokens_per_sec_steady"]
+                / off_a["tokens_per_sec_steady"]
+            )
+        return offs, ons, pair_overheads, pair_incl_drain, control_fracs
+
+    best, rounds_run = None, 0
+    for _ in range(max_rounds):
+        rounds_run += 1
+        candidate = one_round()
+        floor = max(abs(x) for x in candidate[4])
+        if best is None or floor < max(abs(x) for x in best[4]):
+            best = candidate
+        if floor <= floor_accept:
+            break
+        print(json.dumps({"overhead_reround": {
+            "noise_floor_frac": round(floor, 4)}}), file=sys.stderr)
+    return (*best, rounds_run)
+
+
+def _overhead_summary(offs, ons, pair_overheads, pair_incl_drain,
+                      control_fracs) -> dict:
+    """The shared scored fields of a paired-rounds overhead row (see
+    bench_obs_overhead's docstring for the semantics of each)."""
+    import statistics
+
+    return {
+        "tokens_per_sec_off": round(statistics.median(
+            r["tokens_per_sec_steady"] for r in offs), 2),
+        "tokens_per_sec_on": round(statistics.median(
+            r["tokens_per_sec_steady"] for r in ons), 2),
+        "tokens_per_sec_off_reps": [
+            r["tokens_per_sec_steady"] for r in offs
+        ],
+        "tokens_per_sec_on_reps": [
+            r["tokens_per_sec_steady"] for r in ons
+        ],
+        "overhead_frac": round(statistics.median(pair_overheads), 4),
+        "overhead_frac_pairs": [round(x, 4) for x in pair_overheads],
+        "overhead_frac_incl_drain": round(
+            statistics.median(pair_incl_drain), 4
+        ),
+        "control_frac": round(statistics.median(control_fracs), 4),
+        "control_frac_pairs": [round(x, 4) for x in control_fracs],
+        "noise_floor_frac": round(
+            max(abs(x) for x in control_fracs), 4
+        ),
+        "overhead_net_of_control_frac": round(
+            statistics.median(pair_overheads)
+            - statistics.median(control_fracs), 4
+        ),
+        # median ACROSS reps (run order would pick an arbitrary rep on
+        # a noisy box)
+        "p50_latency_off_s": statistics.median(
+            r["p50_latency_s"] for r in offs
+            if r["p50_latency_s"] is not None
+        ),
+        "p50_latency_on_s": statistics.median(
+            r["p50_latency_s"] for r in ons
+            if r["p50_latency_s"] is not None
+        ),
+    }
+
+
 def bench_obs_overhead(model=None, params=None, slots: int = 8,
                        chunk: int = 4, n_requests: int = 128,
                        max_new: int = 256, prompt_len: int = 8,
@@ -1099,107 +1239,105 @@ def bench_obs_overhead(model=None, params=None, slots: int = 8,
             finally:
                 gc.enable()
 
-        def one_round():
-            offs, ons = [], []
-            pair_overheads, pair_incl_drain, control_fracs = [], [], []
-            for rep in range(reps):
-                off_a = timed_pass(False)
-                on = timed_pass(True)
-                off_b = timed_pass(False)
-                # alternate which off-neighbour the on-pass is scored
-                # against, so within-rep decay doesn't always bill one
-                # side
-                off = off_a if rep % 2 == 0 else off_b
-                offs.append(off)
-                ons.append(on)
-                pair_overheads.append(
-                    1.0 - on["tokens_per_sec_steady"]
-                    / off["tokens_per_sec_steady"]
-                )
-                pair_incl_drain.append(
-                    1.0 - on["tokens_per_sec"] / off["tokens_per_sec"]
-                )
-                # the zero-difference control: two identical dark passes
-                control_fracs.append(
-                    1.0 - off_b["tokens_per_sec_steady"]
-                    / off_a["tokens_per_sec_steady"]
-                )
-            return (offs, ons, pair_overheads, pair_incl_drain,
-                    control_fracs)
-
         # re-round on a depressed box (the fleet bench's discipline),
         # selecting on the CONTROL's floor — never on the telemetry
-        # estimate itself
-        best, rounds_run = None, 0
-        for _ in range(max_rounds):
-            rounds_run += 1
-            candidate = one_round()
-            floor = max(abs(x) for x in candidate[4])
-            if best is None or floor < max(abs(x) for x in best[4]):
-                best = candidate
-            if floor <= floor_accept:
-                break
-            print(json.dumps({"obs_overhead_reround": {
-                "noise_floor_frac": round(floor, 4)}}), file=sys.stderr)
-        offs, ons, pair_overheads, pair_incl_drain, control_fracs = best
+        # estimate itself. The scored fields (see _overhead_summary):
+        # overhead_frac is the median of back-to-back per-pair STEADY
+        # overheads (negative = ON measured faster than its paired OFF,
+        # i.e. the effect is below this box's noise floor); the
+        # incl-drain figure adds the one-off exposition I/O at drain (a
+        # per-drain cost, not a per-token one); control_frac is what
+        # this protocol reports for two IDENTICAL dark passes — the
+        # bound is met when overhead_frac is within the control's
+        # spread of <= 2%; overhead_net_of_control_frac is the estimate
+        # net of the true-zero reading, the closest thing to the real
+        # figure the noise allows.
+        (offs, ons, pair_overheads, pair_incl_drain, control_fracs,
+         rounds_run) = _paired_rounds(
+            timed_pass, reps, max_rounds, floor_accept,
+        )
     finally:
         shutil.rmtree(obs_dir, ignore_errors=True)
-    off_med = statistics.median(r["tokens_per_sec_steady"] for r in offs)
-    on_med = statistics.median(r["tokens_per_sec_steady"] for r in ons)
     out = {
         "slots": slots, "chunk": chunk, "n_requests": n_requests,
         "max_new_tokens": max_new, "reps_paired": reps,
         "rounds_run": rounds_run, "floor_accept": floor_accept,
-        "tokens_per_sec_off": round(off_med, 2),
-        "tokens_per_sec_on": round(on_med, 2),
-        "tokens_per_sec_off_reps": [
-            r["tokens_per_sec_steady"] for r in offs
-        ],
-        "tokens_per_sec_on_reps": [
-            r["tokens_per_sec_steady"] for r in ons
-        ],
-        # the scored figure: median of back-to-back per-pair STEADY
-        # overheads (negative = ON measured faster than its paired OFF,
-        # i.e. the effect is below this box's noise floor). The
-        # incl-drain figure adds the one-off exposition I/O at drain
-        # (trace flush, final metrics dump, flight dumps) — a per-drain
-        # cost, not a per-token one.
-        "overhead_frac": round(statistics.median(pair_overheads), 4),
-        "overhead_frac_pairs": [round(x, 4) for x in pair_overheads],
-        "overhead_frac_incl_drain": round(
-            statistics.median(pair_incl_drain), 4
-        ),
-        # the zero-difference control: what this protocol reports for
-        # two IDENTICAL dark passes — the box's noise floor. The bound
-        # is met when overhead_frac is within the control's spread of
-        # <= 2%; |control| ~ |overhead| means the telemetry effect is
-        # unresolvable on this box (i.e. below the floor).
-        "control_frac": round(statistics.median(control_fracs), 4),
-        "control_frac_pairs": [round(x, 4) for x in control_fracs],
-        "noise_floor_frac": round(
-            max(abs(x) for x in control_fracs), 4
-        ),
-        # the telemetry estimate net of what the protocol reports for a
-        # true-zero difference on this box — the closest thing to the
-        # real figure the noise allows
-        "overhead_net_of_control_frac": round(
-            statistics.median(pair_overheads)
-            - statistics.median(control_fracs), 4
-        ),
-        # median ACROSS reps (offs/ons are in run order; the middle
-        # element would be an arbitrary rep on a ±14%-noise box)
-        "p50_latency_off_s": statistics.median(
-            r["p50_latency_s"] for r in offs
-            if r["p50_latency_s"] is not None
-        ),
-        "p50_latency_on_s": statistics.median(
-            r["p50_latency_s"] for r in ons
-            if r["p50_latency_s"] is not None
-        ),
+        **_overhead_summary(offs, ons, pair_overheads, pair_incl_drain,
+                            control_fracs),
         "bound": "telemetry fully on costs <= 2% steady tokens/s "
                  "(within the measured off-vs-off noise floor)",
     }
     return out
+
+
+def bench_slo_scrape(model=None, params=None, slots: int = 8,
+                     chunk: int = 4, n_requests: int = 128,
+                     max_new: int = 256, prompt_len: int = 8,
+                     rate_per_s: float = 500.0, reps: int = 3,
+                     scrape_interval_ms: float = 250.0,
+                     config: str = "tiny", max_rounds: int = 3,
+                     floor_accept: float = 0.1) -> dict:
+    """ISSUE 10 acceptance row: what does serving the LIVE /metrics
+    endpoint — and having a client actually scrape it every 250 ms for
+    the whole run — cost the slots=8 serving path?
+
+    Same protocol as the obs_overhead row (PR 9's paired-rounds method:
+    off/on/off per rep with alternating pairing, an off-vs-off control
+    calibrating the box's noise floor, re-rounding on the control).
+    The ON pass binds an ephemeral ObsHTTPServer (ServeConfig
+    metrics_port=0) and a scraper thread GETs /metrics at the given
+    cadence mid-stream; each scrape renders one Prometheus snapshot
+    from host-side cells — zero device syncs, zero compiles (the
+    cache-stat half of the acceptance is pinned in tests/test_obs.py).
+    The bound: steady tokens/s within 2% of the dark run, net of the
+    off-vs-off control."""
+    import gc
+    import statistics
+
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import SampleConfig
+
+    if model is None:
+        model, params = _decode_model(config, prompt_len, max_new)
+    sample = SampleConfig(temperature=0.0)
+    arrivals = _serve_trace(n_requests, rate_per_s)
+    prompt = jnp.ones((1, prompt_len), jnp.int32)
+    _free_device_memory()
+    for warm_scrape in (None, scrape_interval_ms):  # warm BOTH paths
+        _serve_one_trace(
+            model, params, slots, chunk, arrivals, prompt, sample,
+            max_new, warm=True, scrape_ms=warm_scrape,
+        )
+
+    def timed_pass(with_scrape: bool):
+        gc.collect()
+        gc.disable()
+        try:
+            return _serve_one_trace(
+                model, params, slots, chunk, arrivals, prompt, sample,
+                max_new, warm=False,
+                scrape_ms=scrape_interval_ms if with_scrape else None,
+            )
+        finally:
+            gc.enable()
+
+    (offs, ons, pair_overheads, pair_incl_drain, control_fracs,
+     rounds_run) = _paired_rounds(timed_pass, reps, max_rounds,
+                                  floor_accept)
+    return {
+        "slots": slots, "chunk": chunk, "n_requests": n_requests,
+        "max_new_tokens": max_new, "reps_paired": reps,
+        "rounds_run": rounds_run, "floor_accept": floor_accept,
+        "scrape_interval_ms": scrape_interval_ms,
+        "scrapes_per_pass": statistics.median(
+            r.get("scrapes", 0) for r in ons
+        ),
+        **_overhead_summary(offs, ons, pair_overheads, pair_incl_drain,
+                            control_fracs),
+        "bound": "live /metrics scraped every 250 ms costs <= 2% "
+                 "steady tokens/s net of the off-vs-off control",
+    }
 
 
 def decode_matrix(batches=(1, 4, 8, 16, 32), prompt_len: int = 512,
@@ -1331,6 +1469,13 @@ def main(argv=None) -> int:
                          "OFF, interleaved reps; updates the "
                          "'obs_overhead' row of BENCH_SERVE.json in "
                          "place (the full --serve run includes it too)")
+    ap.add_argument("--slo-scrape", action="store_true",
+                    help="live-endpoint-cost bench only: slots=8 serving "
+                         "trace with /metrics served AND scraped every "
+                         "250 ms vs dark, paired rounds with an "
+                         "off-vs-off control; updates the 'slo_scrape' "
+                         "row of BENCH_SERVE.json in place (the full "
+                         "--serve run includes it too)")
     ap.add_argument("--remat-sweep", action="store_true",
                     help="policy x skip operating-point sweep (VERDICT r4)")
     args = ap.parse_args(argv)
@@ -1395,6 +1540,30 @@ def main(argv=None) -> int:
             "tokens_per_sec_off": res["tokens_per_sec_off"],
             "tokens_per_sec_on": res["tokens_per_sec_on"],
             "overhead_frac": res["overhead_frac"],
+        }))
+        return 0
+
+    if args.slo_scrape:
+        res = bench_slo_scrape()
+        path = os.path.join(os.path.dirname(__file__), "BENCH_SERVE.json")
+        doc = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                doc = json.load(f)
+        doc["slo_scrape"] = res
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+        print(json.dumps({
+            "metric": "serve_slo_scrape_tiny",
+            "tokens_per_sec_off": res["tokens_per_sec_off"],
+            "tokens_per_sec_on": res["tokens_per_sec_on"],
+            "overhead_frac": res["overhead_frac"],
+            "overhead_net_of_control_frac": res[
+                "overhead_net_of_control_frac"],
+            "scrapes_per_pass": res["scrapes_per_pass"],
         }))
         return 0
 

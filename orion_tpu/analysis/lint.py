@@ -135,6 +135,16 @@ class ModuleContext:
         return "fleet/" in self.path
 
     @property
+    def is_obs(self) -> bool:
+        """The telemetry spine (orion_tpu/obs/): scrape handlers run on
+        daemon HTTP threads against locks the scheduler also holds — a
+        scrape read that blocks unboundedly on a lock or queue turns a
+        wedged scheduler into a wedged endpoint (and vice versa), so the
+        unbounded-wait rule widens its method set here too (including
+        bare ``.acquire()``)."""
+        return "orion_tpu/obs/" in self.path or self.path.startswith("obs/")
+
+    @property
     def is_pallas_module(self) -> bool:
         return "ops/pallas/" in self.path and not self.path.endswith(
             "__init__.py"

@@ -23,6 +23,15 @@ that can be SIGKILLed or wedge in a C call — ``Popen.wait()``,
 supervisor on a corpse, which is exactly the outcome the fleet's
 heartbeat machinery exists to prevent.
 
+In ``orion_tpu/obs/`` it widens further, to ``.wait()``/``.recv()``/
+``.acquire()``: the spine's readers run on scrape-handler daemon
+threads against locks the serving scheduler also holds, so an
+unbounded block there couples the liveness of the /metrics endpoint to
+the liveness of whatever wedged the scheduler — a scrape must return
+or fail, never hang. (``with lock:`` is fine — obs locks are held for
+one snapshot; it is the bare blocking ``acquire()`` call, which can
+carry a timeout and doesn't, that the rule flags.)
+
 ``signal-unsafe-handler`` — a Python signal handler runs between two
                      arbitrary bytecodes of whatever the main thread was
                      doing. Buffered I/O (``print``, ``open``,
@@ -64,11 +73,20 @@ class UnboundedWaitRule:
     # socket recv behind its own settimeout); the fleet's supervision
     # contract is precisely "every cross-process wait is bounded".
     _FLEET_METHODS = ("get", "join", "wait", "recv")
+    # in orion_tpu/obs/ scrape-handler threads read state the scheduler
+    # writes: a bare blocking ``.acquire()`` there welds the endpoint's
+    # liveness to the scheduler's — add it to the widened set
+    _OBS_METHODS = ("get", "join", "wait", "recv", "acquire")
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         if ctx.is_test:
             return
-        methods = self._FLEET_METHODS if ctx.is_fleet else ("get", "join")
+        if ctx.is_obs:
+            methods = self._OBS_METHODS
+        elif ctx.is_fleet:
+            methods = self._FLEET_METHODS
+        else:
+            methods = ("get", "join")
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call) or not isinstance(
                 node.func, ast.Attribute
@@ -84,8 +102,8 @@ class UnboundedWaitRule:
                 continue
             if meth == "get" and kws - {"block"}:
                 continue  # keyword'd non-queue .get()
-            if meth in ("join", "wait", "recv") and kws:
-                continue
+            if meth in ("join", "wait", "recv", "acquire") and kws:
+                continue  # acquire(blocking=False)/acquire(timeout=...) pass
             yield Finding(
                 self.id, ctx.path, node.lineno,
                 f".{meth}() with no timeout blocks forever if the peer "

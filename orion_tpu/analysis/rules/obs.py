@@ -17,13 +17,16 @@ be PURE host code. Two scopes enforce that:
 
 2. **registered hooks** (any ``orion_tpu/`` module): a function handed
    to the spine as a callback — ``gauge_fn(...)`` callables, inject
-   ``add_observer`` subscribers, ``attach_inject`` targets, and
-   callables bound to the hook keywords ``on_event`` / ``on_transition``
-   / ``on_done`` / ``on_stop`` / ``observer`` — runs on the scheduler's
-   hot path (chunk boundaries, signal delivery, metric scrapes). Inside
-   such functions (named functions resolved same-module, plus inline
-   lambdas), the sync-shaped calls above and any ``jax.``/``jnp.``
-   dotted call are findings.
+   ``add_observer`` subscribers, ``attach_inject`` targets, callables
+   bound to the hook keywords ``on_event`` / ``on_transition`` /
+   ``on_done`` / ``on_stop`` / ``observer``, and (since ISSUE 10) the
+   live-endpoint provider keywords ``metrics_fn`` / ``health_fn`` /
+   ``statusz_fn`` / ``slo_fn`` (obs/http.py handlers call them from
+   scrape threads) — runs on the scheduler's hot path (chunk
+   boundaries, signal delivery, metric scrapes). Inside such functions
+   (named functions resolved same-module, plus inline lambdas), the
+   sync-shaped calls above and any ``jax.``/``jnp.`` dotted call are
+   findings.
 
 The ``decode-host-sync`` probe budget is untouched: that rule gates the
 decode LOOPS; this one gates the telemetry layer those loops report
@@ -50,6 +53,11 @@ _HOOK_CALL_NAMES = frozenset({"gauge_fn", "add_observer", "attach_inject"})
 _HOOK_KEYWORDS = frozenset({
     "on_event", "on_transition", "on_done", "on_stop", "observer",
     "on_stall",
+    # obs/http.py provider registration: these callables run on the
+    # endpoint's scrape-handler threads — a /metrics or /healthz GET
+    # must never sync a device value, so every registered provider is
+    # in the banned-sync scope wherever it is defined
+    "metrics_fn", "health_fn", "statusz_fn", "slo_fn",
 })
 
 

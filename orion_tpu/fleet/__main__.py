@@ -67,6 +67,27 @@ def build_argparser() -> argparse.ArgumentParser:
                         "replica's pool spans every CPU and N replicas "
                         "fight for the same cores instead of scaling")
     p.add_argument("--deadline-ms", type=float, default=0.0)
+    p.add_argument("--metrics-port", type=int, default=-1,
+                   help="serve the LIVE fleet-AGGREGATED view on this "
+                        "port (0 = ephemeral, reported on stderr; -1 = "
+                        "off): /metrics sums every replica's registry "
+                        "from the supervisor's heartbeat snapshots "
+                        "(staleness <= --heartbeat-s; no per-scrape "
+                        "RPCs), /healthz is 200 while any replica is "
+                        "routable, /statusz is the router's fleet "
+                        "snapshot, /slo the per-replica burn rates and "
+                        "budgets")
+    p.add_argument("--slo-latency-ms", type=float, default=0.0,
+                   help="declare a per-turn latency SLO on every "
+                        "replica (--slo-target of turns under this "
+                        "many ms): arms the full control loop — fast "
+                        "burn degrades + sheds on the replica, the "
+                        "router tie-breaks on windowed p99, the "
+                        "supervisor drain-respawns a persistent burner")
+    p.add_argument("--slo-target", type=float, default=0.99,
+                   help="good-event fraction each declared objective "
+                        "promises (error budget = 1 - target), as on "
+                        "the single-server CLI")
     p.add_argument("--metrics-path", default=None,
                    help="fleet-AGGREGATED Prometheus-text metrics dump "
                         "(+ .json with the per-replica breakdown), "
@@ -102,20 +123,34 @@ def _spec_from_args(args) -> ReplicaSpec:
         from orion_tpu.utils.config import parse_set_overrides
 
         overrides = parse_set_overrides(args.set)
+    serve = {
+        "slots": args.slots,
+        "chunk": args.chunk,
+        "prefill_chunk": args.prefill_chunk,
+        "prefill_buckets": args.prefill_buckets,
+        "max_inflight": args.replica_max_inflight,
+        "deadline_ms": args.deadline_ms,
+        "grace": args.grace,
+        "session_dir": args.session_dir,
+    }
+    if args.slo_latency_ms > 0:
+        # declared objectives (JSON-able Objective kwargs) arm actuation
+        # inside every replica; the supervisor and router act on the
+        # resulting burn rates over the status op
+        serve["slo"] = [
+            {"name": "turn_latency", "kind": "latency",
+             "latency_ms": args.slo_latency_ms,
+             "target": args.slo_target},
+            {"name": "error_rate", "kind": "error_rate",
+             "target": args.slo_target},
+            {"name": "availability", "kind": "availability",
+             "target": args.slo_target},
+        ]
     return ReplicaSpec(
         config=args.config,
         overrides=overrides or None,
         ckpt_dir=args.ckpt_dir,
-        serve={
-            "slots": args.slots,
-            "chunk": args.chunk,
-            "prefill_chunk": args.prefill_chunk,
-            "prefill_buckets": args.prefill_buckets,
-            "max_inflight": args.replica_max_inflight,
-            "deadline_ms": args.deadline_ms,
-            "grace": args.grace,
-            "session_dir": args.session_dir,
-        },
+        serve=serve,
     )
 
 
@@ -213,7 +248,12 @@ def main(argv=None) -> int:
     rc = 0
     completed = []
     aggregated = None
+    http = None
     try:
+        # inside the try: replicas are already spawned, so a bind
+        # failure (port in use) must still reach the finally's
+        # drain_all — never orphan child decoders over an endpoint
+        http = _start_fleet_http(args, sup)
         import numpy as np
 
         from orion_tpu.serving.session import DecodeRequest
@@ -268,8 +308,83 @@ def main(argv=None) -> int:
             aggregated = sup.aggregate_metrics()
     finally:
         sup.drain_all(timeout=args.grace * 2)
+        if http is not None:
+            http.close()
         _dump_fleet_obs(args, tracer, aggregated)
     return rc
+
+
+def _fleet_healthz(sup) -> dict:
+    """Fleet-level /healthz: 200 while ANY replica is routable (the
+    router can place work), 503 otherwise — a balancer in front of
+    several fleets needs one bit, the body carries the per-replica
+    breakdown."""
+    snap = sup.router.snapshot()
+    routable = [
+        r for r in snap["replicas"]
+        if r["alive"] and r["state"] in ("starting", "serving", "degraded")
+    ]
+    snap["code"] = 200 if routable else 503
+    snap["accepting"] = bool(routable)
+    return snap
+
+
+def _fleet_metrics(sup) -> dict:
+    """Fleet-level /metrics: aggregate over the supervisor-refreshed
+    ``last_status`` snapshots (every heartbeat tick stores one per
+    replica) instead of issuing fresh status RPCs per scrape — a
+    Prometheus scraper on a sub-second interval must not multiply
+    control-channel traffic (or block heartbeat_timeout per wedged
+    replica per GET, piling up handler threads mid-incident). Staleness
+    is bounded by the heartbeat interval; the end-of-run file dump
+    still uses Supervisor.aggregate_metrics for a fresh sweep."""
+    from orion_tpu.obs.metrics import aggregate
+
+    snaps, names = [], []
+    for replica in list(sup.replicas):
+        status = getattr(replica, "last_status", None)
+        m = (status or {}).get("metrics")
+        if m is not None:
+            snaps.append(m)
+            names.append(replica.name)
+    agg = aggregate(snaps, sources=names)
+    agg["replicas"] = len(names)
+    return agg
+
+
+def _fleet_slo(sup) -> dict:
+    """Fleet-level /slo: every replica's burn rates/budgets from its
+    last heartbeat snapshot (the supervisor refreshes them; no extra
+    round-trip from the scrape thread)."""
+    out = {}
+    for replica in list(sup.replicas):
+        status = getattr(replica, "last_status", None)
+        if status and status.get("slo"):
+            out[replica.name] = status["slo"]
+    return {"replicas": out}
+
+
+def _start_fleet_http(args, sup):
+    """The aggregated live endpoint (--metrics-port): /metrics sums the
+    child registries the supervisor's heartbeats already scraped over
+    the existing status op; /healthz, /statusz and /slo serve the fleet
+    view."""
+    if args.metrics_port is None or args.metrics_port < 0:
+        return None
+    from orion_tpu.obs.http import ObsHTTPServer
+
+    http = ObsHTTPServer(
+        port=args.metrics_port,
+        metrics_fn=lambda: _fleet_metrics(sup),
+        health_fn=lambda: _fleet_healthz(sup),
+        statusz_fn=sup.router.snapshot,
+        slo_fn=lambda: _fleet_slo(sup),
+    )
+    port = http.start()
+    print(f"fleet telemetry: http://127.0.0.1:{port}/metrics | /healthz "
+          "| /statusz | /slo (aggregated over the status op)",
+          file=sys.stderr)
+    return http
 
 
 def _dump_fleet_obs(args, tracer, aggregated) -> None:

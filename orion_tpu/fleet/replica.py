@@ -345,6 +345,22 @@ class ReplicaHandle:
             "starting", "serving", "degraded"
         )
 
+    def slo_penalty(self):
+        """Latency-aware routing tie-break, applied AFTER (health rank,
+        inflight): ``(fast-burn firing?, windowed p99 ms)`` from the
+        replica's last status snapshot (the ``slo`` section every
+        ``Server.snapshot()`` carries since ISSUE 10). Deliberately
+        stale-tolerant — the supervisor heartbeat refreshes
+        ``last_status`` once per tick, and a balancer acting on a
+        second-old p99 still beats one acting on none. A replica with no
+        SLO data yet sorts neutral ``(0, 0.0)``: new capacity must not
+        be penalized for having no history."""
+        status = getattr(self, "last_status", None)
+        slo = (status or {}).get("slo") or {}
+        firing = 1 if slo.get("firing_fast") else 0
+        p99 = slo.get("p99_ms")
+        return (firing, p99 if p99 is not None else 0.0)
+
 
 # -- process replica: the real thing ------------------------------------------
 
@@ -692,6 +708,7 @@ class LocalReplica(ReplicaHandle):
         self._lock = threading.Lock()
         self.crashed = False
         self.last_heartbeat: float = 0.0
+        self.last_status: Optional[dict] = None
 
     def start(self) -> "LocalReplica":
         self._thread = threading.Thread(
@@ -747,6 +764,10 @@ class LocalReplica(ReplicaHandle):
             return None
         snap = self.server.snapshot()
         self.last_heartbeat = self._clock()
+        # same contract as ProcessReplica: the freshest snapshot hangs
+        # off the handle, where the router's slo_penalty tie-break and
+        # health_state read it without another round-trip
+        self.last_status = snap
         return snap
 
     def drain(self) -> None:

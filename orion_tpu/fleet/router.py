@@ -6,13 +6,17 @@ handle. "Best" is deliberately simple — the O(1)-state engine makes every
 replica equally able to serve every request (sessions live on shared
 disk, migration is a read), so placement is pure load balancing:
 
-- **least-loaded** — candidates sort by (health rank, in-flight count,
-  index): SERVING/STARTING replicas before DEGRADED ones (a limping
-  replica still serves correctly, PR 4's ladder contract, but it only
-  gets work when every healthy peer is busier), DRAINING/DEAD replicas
-  are never candidates. In-flight counts are router-side (incremented at
-  dispatch, decremented at result) so dispatch needs no status round-trip
-  on the hot path.
+- **least-loaded, latency-aware** — candidates sort by (health rank,
+  in-flight count, SLO penalty, index): SERVING/STARTING replicas before
+  DEGRADED ones (a limping replica still serves correctly, PR 4's ladder
+  contract, but it only gets work when every healthy peer is busier),
+  DRAINING/DEAD replicas are never candidates; equally-healthy,
+  equally-loaded replicas tie-break on (fast-burn firing, windowed p99)
+  from their last status snapshot, so traffic shifts away from a slow
+  replica BEFORE it leaves SERVING. In-flight counts are router-side
+  (incremented at dispatch, decremented at result) so dispatch needs no
+  status round-trip on the hot path; the SLO penalty reads the snapshot
+  the supervisor's heartbeat already refreshes.
 - **bounded fleet admission** — ``max_inflight`` bounds the TOTAL
   in-flight work across the fleet; beyond it ``submit`` sheds with
   :class:`~orion_tpu.serving.server.OverloadError` — the same contract
@@ -92,9 +96,20 @@ class Router:
                     return
             self.replicas.append(new)
 
-    def _candidates(self) -> List[Tuple[int, int, int, ReplicaHandle]]:
-        """Routable replicas, best-first: (health rank, inflight, index).
-        DRAINING/DEAD/dead-process replicas never appear."""
+    def _candidates(self) -> List[Tuple]:
+        """Routable replicas, best-first: (health rank, inflight,
+        slo penalty, index). DRAINING/DEAD/dead-process replicas never
+        appear.
+
+        The SLO penalty — ``(fast-burn firing?, windowed p99 ms)`` from
+        each replica's last status snapshot — is the LATENCY-AWARE
+        tie-break: two equally-healthy, equally-loaded replicas resolve
+        toward the one whose recent window is faster, so traffic shifts
+        away from a slow replica BEFORE its burn degrades it out of the
+        health rank. It deliberately sorts after inflight: a slow idle
+        replica still beats a fast saturated one (queueing behind work
+        is worse than a slow scan), and the penalty can never starve a
+        replica the fleet actually needs for capacity."""
         out = []
         for i, r in enumerate(self.replicas):
             if not r.routable:
@@ -102,8 +117,8 @@ class Router:
             rank = _HEALTH_RANK.get(r.health_state())
             if rank is None:
                 continue
-            out.append((rank, r.inflight, i, r))
-        out.sort(key=lambda t: t[:3])
+            out.append((rank, r.inflight, r.slo_penalty(), i, r))
+        out.sort(key=lambda t: t[:4])
         return out
 
     # -- dispatch -------------------------------------------------------------
@@ -176,7 +191,7 @@ class Router:
         overloads = 0
         owed = True  # does _dispatching still carry this request?
         try:
-            for _, _, _, replica in candidates:
+            for *_, replica in candidates:
                 with self._lock:
                     self._dispatches += 1
                     step = self._dispatches

@@ -20,6 +20,13 @@ deterministically and production runs it on a thread:
 - **exit ⇒ respawn** — a replica that simply died (OOM-killed, crashed)
   is replaced; the router's failover already stopped sending it work the
   moment its channel broke.
+- **persistent fast burn ⇒ drain-and-respawn** — a replica whose SLO
+  fast-burn alert (the ``slo`` section of its status snapshot) fires for
+  ``burn_limit`` consecutive heartbeats is treated like a degraded one:
+  drained and replaced. This closes the gap the health state alone
+  leaves open — a replica can flap SERVING ⇔ DEGRADED on every clean
+  completion while its error budget burns steadily; the burn rate is the
+  signal that doesn't flap. A fresh replica starts with a full budget.
 - **spawn retries** — replica creation runs under the resilience retry
   layer with the ``fleet.replica_spawn`` hook inside the retried region,
   so a transient spawn failure (fork pressure, a slow filesystem) is a
@@ -60,6 +67,7 @@ class Supervisor:
         max_inflight: int = 0,
         heartbeat_timeout: float = 5.0,
         miss_limit: int = 3,
+        burn_limit: int = 3,
         drain_grace: float = 30.0,
         ready_timeout: float = 240.0,
         spawn_retry: Optional[RetryPolicy] = None,
@@ -71,6 +79,7 @@ class Supervisor:
         self.n = int(n)
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.miss_limit = int(miss_limit)
+        self.burn_limit = int(burn_limit)
         self.drain_grace = float(drain_grace)
         self.ready_timeout = float(ready_timeout)
         self.spawn_retry = (
@@ -81,6 +90,7 @@ class Supervisor:
         self._max_inflight = int(max_inflight)
         self._spawn_count = 0  # fleet.replica_spawn's step address
         self._misses: dict = {}
+        self._burns: dict = {}  # consecutive fast-burn heartbeats
         self.replicas: List[ReplicaHandle] = []
         self.router: Optional[Router] = None
         self.events: List[tuple] = []  # (t, replica name, what) audit log
@@ -168,6 +178,51 @@ class Supervisor:
                 self._event(replica.name, "reports dead; respawning")
                 replica.join(timeout=1.0)
                 self._replace(idx, replica)
+            else:
+                # SLO actuation, healing half: a replica can flap
+                # SERVING <-> DEGRADED on every clean completion while
+                # its error budget burns steadily — the fast-burn alert
+                # in the status snapshot is the non-flapping signal. A
+                # burn that persists across burn_limit consecutive
+                # heartbeats gets the degraded treatment: drain (its
+                # sessions suspend to the shared store) and respawn
+                # with a fresh error budget. With default
+                # slo_degrade_ticks the server usually latches itself
+                # DEGRADED within a few boundaries and the branch
+                # above acts first — this path is the backstop for
+                # replicas configured not to self-degrade (large
+                # slo_degrade_ticks) or whose health recovered while
+                # the budget kept burning. Gated on the replica's
+                # "actuate" bit (declared objectives only): the
+                # observe-only defaults report burn but must never buy
+                # a drain-respawn the operator didn't define "bad" for
+                # — under fleet-wide overload that would churn healthy
+                # capacity exactly when it is scarcest.
+                # (availability is excluded like the server's own
+                # actuation: its bad events are sheds/rejects — the
+                # fleet's admission decisions — and respawning a
+                # saturated replica for shedding would churn capacity
+                # under the very overload that caused the sheds)
+                slo = status.get("slo") or {}
+                firing = [
+                    n for n in (slo.get("firing_fast") or [])
+                    if (slo.get("objectives") or {}).get(n, {}).get("kind")
+                    != "availability"
+                ] if slo.get("actuate") else []
+                if firing:
+                    burns = self._burns.get(replica.name, 0) + 1
+                    self._burns[replica.name] = burns
+                    self._event(
+                        replica.name,
+                        f"slo fast burn {','.join(firing)} "
+                        f"({burns}/{self.burn_limit})",
+                    )
+                    if burns >= self.burn_limit:
+                        self._drain_respawn(
+                            idx, replica, "slo fast burn persisted"
+                        )
+                else:
+                    self._burns[replica.name] = 0
 
     def _drain_respawn(self, idx: int, replica: ReplicaHandle,
                        why: str) -> None:
@@ -183,6 +238,7 @@ class Supervisor:
 
     def _replace(self, idx: int, old: ReplicaHandle) -> None:
         self._misses.pop(old.name, None)
+        self._burns.pop(old.name, None)
         new = self._spawn(idx)
         # only reachable via tick()/_drain_respawn(), i.e. after start()
         # built the router (the replicas list IS the router's list)

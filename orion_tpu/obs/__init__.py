@@ -23,15 +23,30 @@ thread, so full telemetry costs host timestamps, never a device sync):
   bounded ring of recent structured events (admissions, evictions,
   ladder rungs, health transitions, fault deliveries, watchdog beats,
   control-channel ops) that auto-dumps to the run directory on
-  DEGRADED/DEAD transitions, ladder exhaustion, SIGTERM drain, and
-  unhandled child exit.
+  DEGRADED/DEAD transitions, ladder exhaustion, SIGTERM drain, watchdog
+  stalls, and unhandled child exit.
+- :mod:`slo` — :class:`~orion_tpu.obs.slo.SLOEngine`: declarative
+  objectives (windowed-quantile latency, error rate, availability) with
+  error budgets and multi-window burn-rate alerts, evaluated at chunk
+  boundaries; the actuation signal behind health degradation, early
+  admission shedding, latency-aware routing, and supervisor
+  drain-and-respawn. ``python -m orion_tpu.obs.slo check`` gates a
+  dumped registry snapshot against declared objectives.
+- :mod:`http` — :class:`~orion_tpu.obs.http.ObsHTTPServer`: a
+  daemon-thread stdlib HTTP server exposing ``/metrics`` (Prometheus
+  text), ``/healthz`` (status code mapped from the health state),
+  ``/statusz`` (human debug page), and ``/slo`` (burn rates + budgets)
+  live, per process — the fleet CLI serves the aggregated view.
 """
 
 from orion_tpu.obs.flight import FlightRecorder
+from orion_tpu.obs.http import ObsHTTPServer
 from orion_tpu.obs.metrics import MetricsRegistry, aggregate
+from orion_tpu.obs.slo import Objective, SLOEngine, quantile_from_counts
 from orion_tpu.obs.trace import Tracer, merge_traces, read_jsonl, span_pairs
 
 __all__ = [
     "MetricsRegistry", "aggregate", "Tracer", "merge_traces",
-    "read_jsonl", "span_pairs", "FlightRecorder",
+    "read_jsonl", "span_pairs", "FlightRecorder", "ObsHTTPServer",
+    "Objective", "SLOEngine", "quantile_from_counts",
 ]

@@ -76,6 +76,7 @@ import dataclasses
 import os
 import signal
 import threading
+import time
 from typing import Callable, List, Optional
 
 _NAN_SITE = "train.nan"
@@ -96,6 +97,9 @@ SITES = {
     "serve.ckpt_load": "generate.load_params, inside retry",
     "serve.tokenizer_io": "serving/server.py tokenizer load, inside retry",
     "serve.chunk": "serving decode loops, each chunk boundary",
+    "serve.chunk_delay": "serving/server.py _step_chunk, INSIDE the timed "
+                         "chunk boundary (step = server-lifetime chunk "
+                         "ordinal) — added host latency for SLO chaos",
     "decode.state_nan": "DecodeSession decode-state poisoning marker",
     "serve.session_save": "serving/session_store.py save, inside retry",
     "serve.session_load": "serving/session_store.py load, inside retry",
@@ -222,6 +226,20 @@ class FaultPlan:
         requests are rejected, the process exits 0."""
         return self.add(
             _CHUNK_SITE, chunk, 1, lambda: signal.raise_signal(sig)
+        )
+
+    def delay_chunk(
+        self, seconds: float, chunk: Optional[int] = None, times: int = 1
+    ) -> "FaultPlan":
+        """Add ``seconds`` of host latency at a serving chunk boundary
+        (site ``serve.chunk_delay``; step = the server-lifetime chunk
+        ordinal, ``None`` = every boundary; ``times < 0`` = unlimited).
+        Latency-shaped degradation becomes deterministically
+        reproducible: the SLO engine's burn-rate alerts, the router's
+        windowed-p99 tie-break, and the supervisor's drain-and-respawn
+        are all chaos-addressable through this one site."""
+        return self.add(
+            "serve.chunk_delay", chunk, times, lambda: time.sleep(seconds)
         )
 
     def poison_decode_state_at(self, chunk: int, times: int = 1) -> "FaultPlan":

@@ -101,6 +101,24 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-interval-s", type=float, default=10.0,
                    help="periodic metrics dump cadence (<= 0: on drain "
                         "only)")
+    p.add_argument("--metrics-port", type=int, default=-1,
+                   help="serve LIVE /metrics (Prometheus text), /healthz "
+                        "(status code tracks the health state), /statusz "
+                        "(human debug page) and /slo (burn rates + error "
+                        "budgets) on this port from a daemon thread "
+                        "(0 = ephemeral, reported on stderr; -1 = off). "
+                        "Scrapes read host snapshots only — zero device "
+                        "syncs, zero compiles.")
+    p.add_argument("--slo-latency-ms", type=float, default=0.0,
+                   help="declare a per-turn latency SLO: 99%% of turns "
+                        "under this many ms (plus error-rate and "
+                        "availability objectives at --slo-target). "
+                        "Arms ACTUATION: sustained fast burn degrades "
+                        "health and sheds admissions earlier. 0 = "
+                        "observe-only defaults")
+    p.add_argument("--slo-target", type=float, default=0.99,
+                   help="good-event fraction each declared objective "
+                        "promises (error budget = 1 - target)")
     p.add_argument("--trace-path", default=None,
                    help="request-trace JSONL (Chrome trace events): one "
                         "span per request lifecycle, chunk spans at "
@@ -192,6 +210,19 @@ def _run(args, guard) -> int:
     sample = SampleConfig(
         args.temperature, args.top_k, args.top_p, eos_token=eos_token
     )
+    slo_cfg = None
+    if args.slo_latency_ms > 0:
+        # declared objectives arm actuation (sustained fast burn ->
+        # DEGRADED + earlier shedding); without the flag the server still
+        # evaluates the observe-only defaults
+        slo_cfg = (
+            {"name": "turn_latency", "kind": "latency",
+             "latency_ms": args.slo_latency_ms, "target": args.slo_target},
+            {"name": "error_rate", "kind": "error_rate",
+             "target": args.slo_target},
+            {"name": "availability", "kind": "availability",
+             "target": args.slo_target},
+        )
     server = Server(
         model, params,
         ServeConfig(
@@ -205,8 +236,12 @@ def _run(args, guard) -> int:
             metrics_path=args.metrics_path,
             metrics_interval_s=args.metrics_interval_s,
             trace_path=args.trace_path, flight_dir=args.flight_dir,
+            metrics_port=args.metrics_port, slo=slo_cfg,
         ),
     )
+    if server.http_port is not None:
+        print(f"live telemetry: http://127.0.0.1:{server.http_port}"
+              "/metrics | /healthz | /statusz | /slo", file=sys.stderr)
     if args.session_dir and server.session_store is not None:
         known = server.session_store.list_sessions()
         if known:

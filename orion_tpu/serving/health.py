@@ -54,6 +54,28 @@ class InvalidTransition(RuntimeError):
     """An illegal health edge was requested (e.g. DRAINING -> SERVING)."""
 
 
+# The documented ``/healthz`` status-code mapping (obs/http.py serves the
+# endpoint; the serving layer stamps this code into the payload): load
+# balancers speak HTTP status codes, so the CODE answers "send traffic
+# here?" while the JSON body says why.
+#
+#   STARTING -> 503  not ready (compiles / checkpoint load in progress;
+#                    submits queue, but a balancer must not target it yet)
+#   SERVING  -> 200
+#   DEGRADED -> 200  correct but limping: still routable — the router
+#                    deprioritizes it on the reported state and burn
+#                    rates; shedding it outright is the supervisor's call
+#   DRAINING -> 503  finishing in-flight work, accepting nothing new
+#   DEAD     -> 503
+HTTP_STATUS = {
+    Health.STARTING: 503,
+    Health.SERVING: 200,
+    Health.DEGRADED: 200,
+    Health.DRAINING: 503,
+    Health.DEAD: 503,
+}
+
+
 class HealthMachine:
     """Validated, thread-safe health transitions with a timestamped
     history (the post-mortem artifact: *when* did we degrade, *what*
@@ -149,4 +171,4 @@ class HealthMachine:
             }
 
 
-__all__ = ["Health", "HealthMachine", "InvalidTransition"]
+__all__ = ["Health", "HealthMachine", "InvalidTransition", "HTTP_STATUS"]

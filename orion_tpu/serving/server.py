@@ -60,14 +60,16 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from orion_tpu.obs import slo as obs_slo
 from orion_tpu.obs.flight import FlightRecorder
+from orion_tpu.obs.http import ObsHTTPServer
 from orion_tpu.obs.metrics import MetricsRegistry
 from orion_tpu.obs.trace import Tracer
 from orion_tpu.resilience.inject import fire
 from orion_tpu.resilience.preempt import PreemptionGuard
 from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
 from orion_tpu.resilience.watchdog import Watchdog
-from orion_tpu.serving.health import Health, HealthMachine
+from orion_tpu.serving.health import HTTP_STATUS, Health, HealthMachine
 from orion_tpu.serving.session import DecodeRequest, DecodeResult
 from orion_tpu.serving.session_store import SessionState, SessionStore
 
@@ -126,8 +128,28 @@ class ServeConfig:
     # Perfetto)
     trace_path: Optional[str] = None
     # flight-recorder auto-dumps (DEGRADED/DRAINING/DEAD transitions,
-    # ladder exhaustion) land here; None = ring only, no dumps
+    # ladder exhaustion, watchdog stalls) land here; None = ring only,
+    # no dumps
     flight_dir: Optional[str] = None
+    # -- live exposition + SLO control loop (obs/http.py, obs/slo.py) --
+    # TCP port for the per-process /metrics /healthz /statusz /slo
+    # endpoints (-1 = no HTTP server; 0 = ephemeral — the bound port is
+    # Server.http_port). The handlers read host-side snapshots only
+    # (lint rule obs-device-sync covers every registered provider), so
+    # a scrape mid-stream costs the scraper's thread, never a device
+    # sync or a compile.
+    metrics_port: int = -1
+    # declarative SLOs: a list/tuple of obs.slo.Objective kwarg dicts
+    # (JSON-able — rides ReplicaSpec.serve unchanged). None = the
+    # observe-only defaults (error rate + availability at 99%): burn
+    # rates are computed and exposed either way, but ACTUATION
+    # (DEGRADED + early shedding) arms only for explicitly declared
+    # objectives — a default must never shed traffic the operator
+    # didn't define "slow" for.
+    slo: Optional[tuple] = None
+    # consecutive chunk-boundary evaluations with a fast-burn alert
+    # firing before the server degrades itself and sheds early
+    slo_degrade_ticks: int = 3
 
 
 @dataclasses.dataclass
@@ -224,10 +246,12 @@ class Server:
             clock=clock, dump_dir=cfg.flight_dir,
         )
         self._h_chunk_ms = self.metrics.histogram("chunk_ms")
+        self._h_turn_ms = self.metrics.histogram("turn_latency_ms")
         self._h_session_save_ms = self.metrics.histogram("session_save_ms")
         self._h_session_load_ms = self.metrics.histogram("session_load_ms")
         self._c_ladder = self.metrics.counter("ladder_rungs")
         self._c_health = self.metrics.counter("health_transitions")
+        self._c_slo_alerts = self.metrics.counter("slo_alerts")
         self._rid_seq = 0
         # per-server token inside every trace id: two replicas (or one
         # replica restarted) sharing a trace file must never collide on
@@ -302,6 +326,40 @@ class Server:
         # put landing between the serve loop's last empty-check and DEAD
         # would strand a Pending whose done event never fires.
         self._admission_lock = threading.Lock()
+        # -- SLO control loop (obs/slo.py): windowed views over the SAME
+        # registry cells, evaluated at chunk boundaries. tick() reads the
+        # cells under the stats lock FIRST, then updates its own state
+        # under the engine's private lock — the two are never held
+        # together, so a scrape thread reading state() can't deadlock
+        # against the scheduler.
+        declared = bool(cfg.slo)  # slo=[]/() is "nothing declared" too
+        objectives = (
+            [obs_slo.Objective(**dict(d)) for d in cfg.slo]
+            if declared else obs_slo.default_objectives()
+        )
+        self.slo = obs_slo.SLOEngine(
+            objectives, obs_slo.registry_readers(self.metrics), clock=clock,
+        )
+        self._slo_actuate = declared
+        self._slo_burn_ticks = 0
+        self._slo_shedding = False
+        self._slo_slow_prev = False
+        self._chunk_seq = 0  # serve.chunk_delay's step address
+        # -- live exposition (obs/http.py): /metrics /healthz /statusz
+        # /slo on a daemon thread; stays up across serve() calls (a
+        # balancer must see DRAINING/DEAD as 503, not connection
+        # refused) and closes with close()
+        self.http: Optional[ObsHTTPServer] = None
+        self.http_port: Optional[int] = None
+        if cfg.metrics_port >= 0:
+            self.http = ObsHTTPServer(
+                port=cfg.metrics_port,
+                metrics_fn=self.metrics.snapshot,
+                health_fn=self._healthz,
+                statusz_fn=self._statusz,
+                slo_fn=self.slo.state,
+            )
+            self.http_port = self.http.start()
 
     @property
     def stats(self) -> Dict[str, int]:
@@ -325,6 +383,25 @@ class Server:
     def _on_store_io(self, op: str, ms: float) -> None:
         (self._h_session_save_ms if op == "save"
          else self._h_session_load_ms).observe(ms)
+
+    def _healthz(self) -> dict:
+        """/healthz payload: the health snapshot stamped with the
+        documented HTTP code for its state (health.HTTP_STATUS) — the
+        code answers "route traffic here?", the body says why."""
+        snap = self.health.snapshot()
+        snap["code"] = HTTP_STATUS[Health(snap["state"])]
+        return snap
+
+    def _statusz(self) -> dict:
+        """/statusz payload (rendered as the human debug page): the
+        atomic server snapshot — health, stats, slot phases, resident
+        sessions — plus SLO budgets and the flight ring's tail. All
+        host-side reads; the registry's full cell dump stays on
+        /metrics where a scraper wants it."""
+        snap = self.snapshot()
+        snap.pop("metrics", None)
+        snap["flight_tail"] = self.flight.events()[-20:]
+        return snap
 
     def _on_health(self, old, new, reason: str) -> None:
         """HealthMachine transition tap (runs AFTER the machine released
@@ -392,15 +469,28 @@ class Server:
                              session=request.session_id)
             self.trace.begin("queue", pending.rid)
             try:
+                # SLO actuation, admission half: while the fast-burn
+                # alert is sustained the effective queue bound HALVES —
+                # a replica that is already missing its latency
+                # objective must not absorb a deep backlog whose tail is
+                # all deadline misses; shedding earlier pushes the
+                # router's failover to a healthy peer NOW
+                if (self._slo_shedding and self._q.qsize()
+                        >= max(1, self.cfg.max_inflight // 2)):
+                    raise queue.Full
                 self._q.put_nowait(pending)
             except queue.Full:
                 self._bump("shed")
                 self.trace.end("queue", pending.rid)
                 self.trace.end("request", pending.rid, status="shed")
-                raise OverloadError(
-                    f"admission queue full ({self.cfg.max_inflight} queued "
-                    f"+ up to {self.cfg.slots} resident in slots)"
-                ) from None
+                why = (
+                    "slo fast burn: shedding at half the admission bound"
+                    if self._slo_shedding
+                    else f"admission queue full ({self.cfg.max_inflight} "
+                         f"queued + up to {self.cfg.slots} resident in "
+                         "slots)"
+                )
+                raise OverloadError(why) from None
         self._bump("admitted")
         return pending
 
@@ -462,6 +552,7 @@ class Server:
                             self._complete(pending, result)
                     self._tick_sessions()
                     self._tick_metrics()
+                    self._tick_slo()
                     self._admit_from_queue(wd)
                     if not self.engine.busy:
                         if (draining or drain_when_idle) and self._q.empty():
@@ -507,6 +598,55 @@ class Server:
                 self.trace.flush()
         return 0
 
+    def _tick_slo(self) -> None:
+        """Chunk-boundary SLO evaluation + actuation. Evaluation always
+        runs (the burn rates feed /slo, snapshot()['slo'], the router's
+        tie-break and the supervisor's respawn trigger); ACTUATION —
+        health DEGRADED plus earlier admission shedding — arms only for
+        explicitly declared objectives and only after
+        ``slo_degrade_ticks`` consecutive boundaries with a fast-burn
+        alert firing, so one bad window can't flap the health machine."""
+        st = self.slo.tick()
+        # availability measures OUR OWN admission decisions (bad events
+        # are sheds/rejects), so it must never drive more shedding: a
+        # saturated server that sheds at its normal bound would fire the
+        # availability burn, halve the bound, shed MORE, and latch
+        # half-capacity until offered load drops — a self-sustaining
+        # feedback loop. Availability burn still reports (and the router
+        # still routes away from it); only ACTUATION excludes it. The
+        # supervisor applies the same filter on its side.
+        firing = [
+            n for n in st["firing_fast"]
+            if st["objectives"][n]["kind"] != "availability"
+        ]
+        if firing:
+            self._slo_burn_ticks += 1
+            if self._slo_burn_ticks == 1:
+                # rising edge: count + black-box the alert
+                self._c_slo_alerts.inc(labels={"alert": "fast"})
+                self.flight.record(
+                    "slo", alert="fast", firing=list(firing),
+                    burn=st["worst_burn_fast"],
+                )
+        else:
+            self._slo_burn_ticks = 0
+            if self._slo_shedding:
+                self._slo_shedding = False
+                self.flight.record("slo", alert="clear")
+        slow = bool(st["firing_slow"])
+        if slow and not self._slo_slow_prev:
+            self._c_slo_alerts.inc(labels={"alert": "slow"})
+        self._slo_slow_prev = slow
+        if (self._slo_actuate
+                and self._slo_burn_ticks
+                >= max(self.cfg.slo_degrade_ticks, 1)):
+            if not self._slo_shedding:
+                self._slo_shedding = True
+                self.flight.record(
+                    "slo", alert="shedding", firing=list(firing),
+                )
+            self._degrade("slo fast burn: " + ",".join(firing))
+
     def _tick_metrics(self, force: bool = False) -> None:
         """Periodic metrics exposition at chunk-boundary cadence (and
         forced on drain/exit). Interval <= 0 means on-drain only; a
@@ -526,11 +666,15 @@ class Server:
 
     def close(self) -> None:
         """Finalize a server whose loop exited idle: reject anything still
-        queued and go DEAD."""
+        queued, go DEAD, and take the exposition endpoint down (it stays
+        up through drains so balancers see 503, not connection refused)."""
         with self._admission_lock:
             self._reject_leftovers()
             if self.health.state is not Health.DEAD:
                 self.health.to(Health.DEAD, "closed")
+        if self.http is not None:
+            self.http.close()
+            self.http = None
 
     # -- scheduler internals --------------------------------------------------
 
@@ -781,6 +925,11 @@ class Server:
         infos = self.engine.slot_info() if self.trace.enabled else ()
         t0 = self._clock()
         finished = self.engine.step()
+        self._chunk_seq += 1
+        # INSIDE the timed window: injected latency lands in chunk_ms
+        # (and every resident turn's latency) exactly like a slow scan
+        # would — the deterministic address for latency-shaped chaos
+        fire("serve.chunk_delay", step=self._chunk_seq)
         dt = self._clock() - t0
         with self._stats_lock:
             self._bump("chunks")
@@ -822,7 +971,14 @@ class Server:
                 f"request needed the ladder (rewinds={result.rewinds}, "
                 f"reprefills={result.reprefills}, status={result.status})"
             )
-        elif self.health.state is Health.DEGRADED:
+        elif self.health.state is Health.DEGRADED and not self._slo_shedding:
+            # the SLO latch holds DEGRADED while the burn persists:
+            # without the gate, clean-but-slow completions would flap
+            # DEGRADED<->SERVING once per request — and every re-entry
+            # into DEGRADED writes a fresh flight dump on the scheduler
+            # thread, disk I/O that worsens the very latency being
+            # alarmed on. Burn clears -> _slo_shedding drops -> the next
+            # clean completion recovers as before.
             self.health.to(Health.SERVING, "clean request completed")
         self._finalize(pending, result.status)
 
@@ -831,6 +987,14 @@ class Server:
         closes the request's trace span, releases the waiter, and runs
         the ``on_done`` tap (the fleet router's root-span close)."""
         pending.done_at = self._clock()
+        if pending.result is not None:
+            # per-turn latency (admission -> release, queue wait
+            # included): the SLO engine's primary windowed signal.
+            # Rejected-at-shutdown pendings carry no result and record
+            # nothing — a drain is not a latency event.
+            self._h_turn_ms.observe(
+                (pending.done_at - pending.admitted_at) * 1e3
+            )
         self.trace.end("request", pending.rid, status=status,
                        session=pending.request.session_id)
         pending.done.set()
@@ -878,6 +1042,18 @@ class Server:
                 "in_slots": len(self._active_sessions),
             }
             snap["queued"] = self._q.qsize()
+            # the SLO state rides the snapshot so the fleet layer can
+            # act on burn rates over the EXISTING status op: the
+            # router's latency tie-break and the supervisor's
+            # persistent-fast-burn respawn both read this section.
+            # state() is the last tick's payload — no reader runs here,
+            # so the slo lock nests under the stats lock without a
+            # cycle (tick() never holds its lock while taking ours).
+            # "actuate" carries the declared-objectives bit: the
+            # supervisor must not drain-respawn on the observe-only
+            # defaults' burn any more than the server itself sheds on
+            # them.
+            snap["slo"] = dict(self.slo.state(), actuate=self._slo_actuate)
             # the full registry rides along so a fleet supervisor can
             # aggregate child registries over the existing status op
             snap["metrics"] = self.metrics.snapshot()
@@ -900,6 +1076,14 @@ class Server:
         # watchdog tap: beats + stalls into the black box (the ring is
         # bounded, so per-chunk beats are cheap context, not a leak)
         self.flight.record("watchdog", event=event, detail=detail)
+        if event == "stall":
+            # a hang is exactly when the black box matters most — PR 9
+            # dumped on health transitions, ladder exhaustion and
+            # nan-halt, but a StallError detection itself left no
+            # artifact (the DEGRADED transition it may cause is
+            # suppressed when the server is already degraded). Dump on
+            # the tap, before anything scrolls the stall's context off.
+            self.flight.dump("watchdog-stall")
 
     def _on_stall(self, diag: str) -> None:
         # watchdog monitor thread, NOT a signal handler: buffered io is fine

@@ -512,6 +512,92 @@ def pump(worker):
     )
 
 
+def test_unbounded_wait_obs_scope_widens_to_acquire_and_wait():
+    """In orion_tpu/obs/ scrape-handler threads read state the scheduler
+    writes: no-timeout ``.acquire()``/``.wait()``/``.recv()`` are
+    findings there (ISSUE 10) — a hung scheduler must surface as a
+    failed scrape, never a hung /metrics endpoint. Bounded and
+    non-blocking forms pass; outside obs/ and fleet/ the widened names
+    stay un-flagged."""
+    bad = """
+def scrape(lock, ev, conn):
+    lock.acquire()
+    ev.wait()
+    return conn.recv()
+"""
+    clean = """
+def scrape(lock, ev, conn):
+    if not lock.acquire(timeout=1.0):
+        return None
+    got = lock.acquire(blocking=False)
+    ev.wait(timeout=0.5)
+    conn.settimeout(2.0)
+    return conn.recv(4096), got
+"""
+    assert "unbounded-wait" in rule_ids(
+        lint_source(bad, path="orion_tpu/obs/http_dummy.py")
+    )
+    assert "unbounded-wait" not in rule_ids(
+        lint_source(clean, path="orion_tpu/obs/http_dummy.py")
+    )
+    # outside obs/ (and fleet/) acquire/wait/recv stay un-flagged...
+    assert "unbounded-wait" not in rule_ids(
+        lint_source(bad, path="orion_tpu/training/dummy.py")
+    )
+    # ...and the classic get/join findings still fire inside obs/
+    classic = """
+import queue
+
+_q = queue.Queue()
+
+def pump(worker):
+    worker.join()
+    return _q.get()
+"""
+    assert "unbounded-wait" in rule_ids(
+        lint_source(classic, path="orion_tpu/obs/metrics_dummy.py")
+    )
+
+
+def test_obs_device_sync_covers_http_provider_keywords():
+    """Functions registered as obs/http.py endpoint providers
+    (metrics_fn/health_fn/statusz_fn/slo_fn) run on scrape-handler
+    threads: a device sync inside one stalls the serving process once
+    per scrape — ISSUE 10 puts them in the banned-sync scope. The same
+    body unregistered stays un-flagged."""
+    bad = """
+def healthz_payload(server):
+    return {"loss": float(server.state.sum())}  # syncs per scrape
+
+def wire(http_cls, server):
+    return http_cls(port=0, health_fn=healthz_payload)
+"""
+    assert "obs-device-sync" in rule_ids(
+        lint_source(bad, path="orion_tpu/serving/dummy.py")
+    )
+    clean = """
+def healthz_payload(server):
+    return {"state": server.health_value, "code": 200}
+
+def wire(http_cls, server):
+    return http_cls(port=0, health_fn=healthz_payload)
+
+def host_eval(x):
+    return float(x)  # NOT registered: plain host code is fine
+"""
+    assert "obs-device-sync" not in rule_ids(
+        lint_source(clean, path="orion_tpu/serving/dummy.py")
+    )
+    # lambdas registered as providers are claimed too
+    lam = """
+def wire(http_cls, engine):
+    return http_cls(port=0, slo_fn=lambda: float(engine.state.sum()))
+"""
+    assert "obs-device-sync" in rule_ids(
+        lint_source(lam, path="orion_tpu/fleet/dummy.py")
+    )
+
+
 def test_unbounded_wait_exempts_tests():
     src = """
 import queue
