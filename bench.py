@@ -336,7 +336,8 @@ def _serve_trace(n_requests: int, rate_per_s: float, seed: int = 0):
 
 
 def _serve_one_trace(model, params, slots, chunk, arrivals, prompt, sample,
-                     max_new, warm: bool, obs_dir=None, scrape_ms=None):
+                     max_new, warm: bool, obs_dir=None, scrape_ms=None,
+                     serve_kw=None):
     """One timed pass of the arrival trace through a fresh Server at the
     given slot count; returns the metrics row. ``warm``: run one
     throwaway request first so prefill/scan compiles stay out of the
@@ -371,7 +372,7 @@ def _serve_one_trace(model, params, slots, chunk, arrivals, prompt, sample,
     server = Server(
         model, params,
         ServeConfig(chunk=chunk, slots=slots, max_inflight=len(arrivals),
-                    **obs_kw),
+                    **obs_kw, **(serve_kw or {})),
         tracer=tracer,
     )
     scrape_stop, scrapes, scraper = threading.Event(), [0], None
@@ -556,6 +557,34 @@ def bench_serve(
               file=sys.stderr)
     _free_device_memory()
     try:
+        out["qmode"] = bench_serve_qmode(
+            model, params, slots=slot_counts[-1], chunk=chunk,
+            n_requests=n_requests, max_new=max_new, prompt_len=prompt_len,
+            rate_per_s=rate_per_s, reps=reps,
+        )
+        print(json.dumps({"serve_qmode": {
+            m: out["qmode"]["rows"][m]["tokens_per_sec"]
+            for m in out["qmode"]["rows"]
+        }}), file=sys.stderr)
+    except Exception as e:
+        out["qmode_error"] = repr(e)
+        print(json.dumps({"serve_qmode_error": repr(e)}), file=sys.stderr)
+    _free_device_memory()
+    try:
+        out["shared_prefix"] = bench_shared_prefix(reps=reps)
+        print(json.dumps({"serve_shared_prefix": {
+            "warm_over_cold_tokens_per_sec":
+                out["shared_prefix"]["warm_over_cold_tokens_per_sec"],
+            "admit_cold_over_warm":
+                out["shared_prefix"]["admit_cold_over_warm"],
+            "slo_check": out["shared_prefix"]["slo_check"],
+        }}), file=sys.stderr)
+    except Exception as e:
+        out["shared_prefix_error"] = repr(e)
+        print(json.dumps({"serve_shared_prefix_error": repr(e)}),
+              file=sys.stderr)
+    _free_device_memory()
+    try:
         out["obs_overhead"] = bench_obs_overhead(
             model, params, slots=slot_counts[-1], chunk=chunk,
             n_requests=n_requests, max_new=max_new, prompt_len=prompt_len,
@@ -581,6 +610,334 @@ def bench_serve(
         print(json.dumps({"serve_slo_scrape_error": repr(e)}),
               file=sys.stderr)
     _free_device_memory()
+    return out
+
+
+def bench_serve_qmode(model=None, params=None, slots: int = 8,
+                      chunk: int = 4, n_requests: int = 32,
+                      max_new: int = 256, prompt_len: int = 8,
+                      rate_per_s: float = 500.0, reps: int = 3,
+                      config: str = "tiny") -> dict:
+    """Quantized-serving row: slots=8 tokens/s (and ms/tok) at qmode
+    off / int8 / int4 through the REAL Server (ServeConfig.qmode — each
+    pass quantizes at construction exactly as production does).
+
+    Methodology = the PR 8 interleaved-round discipline: every qmode is
+    alive in the same minutes (box noise is minute-correlated), the
+    per-round visiting order rotates, and each mode is scored by the
+    MEDIAN of its rounds. One untimed warm pass per mode keeps compiles
+    and the quantize dispatch out of the timed windows.
+
+    Honesty note (the r4 int4 rows' precedent): the < 1.0x ms/tok win is
+    a WEIGHT-HBM-ROOFLINE effect — on TPU the int8->compute convert
+    fuses into the dot's weight read, so streaming a quarter of the
+    bytes is a quarter of the stall (BENCH_r05 measured int8 decode at
+    0.69–0.90x fp32 on-chip). This CI box's XLA-CPU lowering
+    MATERIALIZES the dequant instead of fusing it, so the same program
+    measures >= 1.0x here; the row records the CPU ratio as measured
+    plus the on-chip reference, not a number the hardware didn't
+    produce."""
+    import statistics
+
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import SampleConfig
+
+    if model is None:
+        model, params = _decode_model(config, prompt_len, max_new)
+    sample = SampleConfig(temperature=0.0)
+    arrivals = _serve_trace(n_requests, rate_per_s)
+    prompt = jnp.ones((1, prompt_len), jnp.int32)
+    modes = ("off", "int8", "int4")
+    for mode in modes:  # untimed warm pass per mode (compiles + quantize)
+        _serve_one_trace(model, params, slots, chunk, arrivals, prompt,
+                         sample, max_new, warm=True,
+                         serve_kw={"qmode": mode})
+    tps = {mode: [] for mode in modes}
+    for rep in range(max(reps, 3)):
+        order = modes[rep % len(modes):] + modes[:rep % len(modes)]
+        for mode in order:
+            row = _serve_one_trace(model, params, slots, chunk, arrivals,
+                                   prompt, sample, max_new, warm=False,
+                                   serve_kw={"qmode": mode})
+            tps[mode].append(row["tokens_per_sec"])
+    # controlled per-step micro: the engine's batched decode step timed
+    # directly (no arrival process, no queue, no drain tail) — on a noisy
+    # shared box this resolves the model-cost ratio the trace medians
+    # smear; still interleaved (one visit per round per mode)
+    step_ms = {mode: [] for mode in modes}
+    quantized = {}
+    for mode in modes:
+        if mode == "off":
+            quantized[mode] = (model, params)
+        else:
+            from orion_tpu.generate import quantize_for_decode
+
+            quantized[mode] = quantize_for_decode(model, params, mode=mode)
+    from orion_tpu.generate import SampleConfig as _SC
+    from orion_tpu.serving import DecodeRequest, SlotEngine
+
+    micro_chunk, micro_steps = 16, 10
+    for _ in range(3):
+        for mode in modes:
+            m, p = quantized[mode]
+            eng = SlotEngine(m, p, slots=slots, chunk=micro_chunk)
+            cap = m.cfg.max_seq_len - prompt_len - 1
+            for s in range(slots):
+                eng.admit(DecodeRequest(
+                    prompt=prompt, max_new_tokens=cap,
+                    sample=_SC(temperature=0.0), seed=s,
+                ), tag=s)
+            eng.step()  # warm (compiles are cached across rounds)
+            t0 = time.perf_counter()
+            for _ in range(micro_steps):
+                eng.step()
+            step_ms[mode].append(
+                (time.perf_counter() - t0) / micro_steps / micro_chunk
+                * 1e3
+            )
+    out = {
+        "slots": slots, "chunk": chunk, "n_requests": n_requests,
+        "max_new_tokens": max_new, "reps_median_of": max(reps, 3),
+        "interleaved_rounds": True, "rows": {},
+    }
+    for mode in modes:
+        med = statistics.median(tps[mode])
+        out["rows"][mode] = {
+            "tokens_per_sec": round(med, 2),
+            "ms_per_tok": round(1000.0 / med, 5) if med else None,
+            "tokens_per_sec_reps": [round(x, 2) for x in tps[mode]],
+            "decode_step_ms": round(statistics.median(step_ms[mode]), 5),
+        }
+    base = out["rows"]["off"]["ms_per_tok"]
+    base_step = out["rows"]["off"]["decode_step_ms"]
+    for mode in ("int8", "int4"):
+        mt = out["rows"][mode]["ms_per_tok"]
+        out["rows"][mode]["ms_per_tok_vs_off"] = (
+            round(mt / base, 3) if mt and base else None
+        )
+        out["rows"][mode]["decode_step_vs_off"] = round(
+            out["rows"][mode]["decode_step_ms"] / base_step, 3
+        )
+    out["onchip_reference"] = {
+        "int8_decode_vs_fp32": "0.69-0.90x (BENCH_r05, v5e: fused "
+                               "convert rides the dot's weight read)",
+        "note": "this box's XLA-CPU lowering materializes the dequant, "
+                "so the CPU ratio above is >= 1.0 by construction — the "
+                "program is pinned identical (golden "
+                "decode_batched_int8/int4: same carry, zero collectives)",
+    }
+    return out
+
+
+def _prefix_trace_pass(model, params, prefix, suffixes, max_new, slots,
+                       chunk, prefill_chunk, prefix_dir, declare) -> dict:
+    """One pass of the shared-prefix arrival trace: every request is
+    prefix + its own suffix; ``declare`` marks the prefix length on the
+    requests (the publish trigger — a warm store hits regardless)."""
+    import numpy as np
+
+    from orion_tpu.generate import SampleConfig
+    from orion_tpu.serving import DecodeRequest, ServeConfig, Server
+
+    sample = SampleConfig(temperature=0.0)
+    server = Server(model, params, ServeConfig(
+        chunk=chunk, slots=slots, max_inflight=len(suffixes),
+        prefill_chunk=prefill_chunk, prefix_dir=prefix_dir,
+        params_id="bench-shared-prefix",
+    ))
+    stop = _StopFlag()
+    pendings = []
+    clock = time.monotonic
+    t0 = clock()
+    for i, sfx in enumerate(suffixes):
+        prompt = np.concatenate([prefix, sfx], axis=1)
+        req = DecodeRequest(
+            prompt=prompt, max_new_tokens=max_new, sample=sample, seed=i,
+            prefix_len=prefix.shape[1] if declare else 0,
+        )
+        pendings.append((clock(), server.submit(req)))
+
+    def waiter():
+        for _, p in pendings:
+            p.done.wait()
+        stop.should_stop = True
+
+    import threading
+
+    th = threading.Thread(target=waiter, daemon=True)
+    th.start()
+    server.serve(guard=stop)
+    wall = clock() - t0
+    th.join(timeout=30)
+    lats = sorted(p.done_at - sub for sub, p in pendings
+                  if p.result is not None)
+    ok_tokens = sum(p.result.new_tokens for _, p in pendings
+                    if p.result is not None and p.result.status == "ok")
+    flat = server.metrics.counters_flat()
+    snap = server.metrics.snapshot()
+    return {
+        "tokens_per_sec": round(ok_tokens / wall, 2),
+        "wall_s": round(wall, 3),
+        "completed": sum(1 for _, p in pendings if p.result is not None),
+        "p50_latency_s": round(lats[len(lats) // 2], 4) if lats else None,
+        "prefix_hits": flat.get("prefix_hits", 0),
+        "prefix_misses": flat.get("prefix_misses", 0),
+        "prefix_publishes": flat.get("prefix_publishes", 0),
+        "_snapshot": snap,
+    }
+
+
+def bench_shared_prefix(prefix_len: int = 1024, n_requests: int = 64,
+                        suffix_len: int = 16, max_new: int = 32,
+                        slots: int = 8, chunk: int = 4,
+                        prefill_chunk: int = 128, reps: int = 3,
+                        config: str = "tiny") -> dict:
+    """Shared-prefix arrival trace (ISSUE 11): 64 requests sharing one
+    1k-token system prompt, cold store vs warm store.
+
+    Two measurements: (a) the TRACE — the same request set through the
+    real Server against a fresh prefix dir (every request in-scan
+    prefills the full 1k prefix; request 1 publishes it) and then
+    against the now-warm dir (every request hits: admission stages the
+    cached row and prefills only its 16-token suffix); (b) the DIRECT
+    admission cost — wall time from ``admit()`` to the slot finishing
+    its prompt, cold vs warm on one engine (the bench_session_admission
+    idiom), which is the O(prompt) -> O(suffix) number the acceptance
+    bar (>= 5x for a 1k prefix) scores. The warm pass's registry
+    snapshot is gated by ``obs.slo.check_snapshot`` (error-rate +
+    availability at 99%) so a pass that shed or failed requests cannot
+    land as a bench row."""
+    import dataclasses as _dc
+    import shutil
+    import statistics
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orion_tpu.generate import SampleConfig
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.obs import slo as obs_slo
+    from orion_tpu.serving import DecodeRequest, PrefixStore, SlotEngine
+    from orion_tpu.serving.batching import parse_buckets
+
+    cfg = _dc.replace(
+        get_config(config),
+        max_seq_len=max(2048, prefix_len + suffix_len + max_new + chunk),
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(
+        0, cfg.vocab_size, (1, prefix_len), dtype=np.int32
+    )
+    suffixes = [
+        rng.integers(0, cfg.vocab_size, (1, suffix_len), dtype=np.int32)
+        for _ in range(n_requests)
+    ]
+    out = {
+        "config": config, "prefix_len": prefix_len,
+        "n_requests": n_requests, "suffix_len": suffix_len,
+        "max_new_tokens": max_new, "slots": slots, "chunk": chunk,
+        "prefill_chunk": prefill_chunk,
+    }
+    tmp = tempfile.mkdtemp(prefix="orion-prefix-bench-")
+    try:
+        # (a) the arrival trace: the cold pass DOESN'T declare (nothing
+        # publishes mid-trace — every one of the 64 requests genuinely
+        # in-scan prefills the full 1k prefix; a declared cold pass
+        # would commit the entry after the first batch and serve the
+        # remaining ~56 requests warm, quietly shrinking the very ratio
+        # being measured). The store is then seeded with ONE direct
+        # publish and the warm pass hits throughout.
+        cold = _prefix_trace_pass(
+            model, params, prefix, suffixes, max_new, slots, chunk,
+            prefill_chunk, tmp, declare=False,
+        )
+        cold.pop("_snapshot")
+        from orion_tpu.generate import prefill_carry
+        from orion_tpu.ops.dispatch import resolve, resolve_chunk
+
+        align = resolve_chunk(cfg.chunk, cfg.max_seq_len,
+                              resolve(cfg.backend))
+        seed_store = PrefixStore(
+            tmp, params_id="bench-shared-prefix", align=align,
+        )
+        seed_carry = prefill_carry(
+            model, params, jnp.asarray(prefix),
+            SampleConfig(temperature=0.0), jax.random.PRNGKey(0),
+        )
+        seed_store.publish(prefix, seed_carry[1])
+        warm = _prefix_trace_pass(
+            model, params, prefix, suffixes, max_new, slots, chunk,
+            prefill_chunk, tmp, declare=True,
+        )
+        snap = warm.pop("_snapshot")
+        out["trace_cold"] = cold
+        out["trace_warm"] = warm
+        out["warm_over_cold_tokens_per_sec"] = round(
+            warm["tokens_per_sec"] / max(cold["tokens_per_sec"], 1e-9), 2
+        )
+        # gate: the warm pass must hold its availability/error SLOs
+        rows, ok = obs_slo.check_snapshot(
+            [obs_slo.Objective(name="error_rate", kind="error_rate",
+                               target=0.99),
+             obs_slo.Objective(name="availability", kind="availability",
+                               target=0.99)],
+            snap,
+        )
+        out["slo_check"] = "ok" if ok else "VIOLATED"
+        if not ok:
+            out["slo_check_rows"] = rows
+        # (b) direct admission cost, cold vs warm (the acceptance bar)
+        buckets = parse_buckets("pow2", cfg.max_seq_len)
+        cold_ms, warm_ms = [], []
+        sample = SampleConfig(temperature=0.0)
+        for rep in range(max(reps, 3) + 1):
+            eng = SlotEngine(
+                model, params, slots=2, chunk=chunk,
+                prefill_buckets=buckets, prefill_chunk=prefill_chunk,
+            )
+            store = PrefixStore(tmp + f"-admit{rep}", params_id="bench",
+                                align=eng.chunk_align, keep=2)
+            eng.attach_prefix_store(store)
+
+            def drive_admission(eng, sfx, seed, declare):
+                prompt = np.concatenate([prefix, sfx], axis=1)
+                t0 = time.perf_counter()
+                eng.admit(DecodeRequest(
+                    prompt=prompt, max_new_tokens=chunk, sample=sample,
+                    seed=seed, prefix_len=prefix.shape[1] if declare else 0,
+                ), tag=seed)
+                while any(
+                    s is not None and s.prompt_remaining > 0
+                    for s in eng._slots
+                ):
+                    eng.step()
+                jax.block_until_ready(eng._carry)
+                ms = (time.perf_counter() - t0) * 1e3
+                while eng.busy:  # finish the request, free the slot
+                    eng.step()
+                return ms
+
+            c = drive_admission(eng, suffixes[0], 0, declare=True)
+            eng.publish_pending_prefixes()
+            w = drive_admission(eng, suffixes[1], 1, declare=True)
+            assert store.list_keys(), "the cold admission must publish"
+            if rep:  # first lap warms compiles
+                cold_ms.append(c)
+                warm_ms.append(w)
+            shutil.rmtree(tmp + f"-admit{rep}", ignore_errors=True)
+        out["admit_cold_ms"] = round(statistics.median(cold_ms), 3)
+        out["admit_warm_ms"] = round(statistics.median(warm_ms), 3)
+        out["admit_cold_over_warm"] = round(
+            out["admit_cold_ms"] / max(out["admit_warm_ms"], 1e-9), 2
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
     return out
 
 
@@ -821,7 +1178,7 @@ def bench_fleet(
     # reaches 90% of its own calibrated ceiling, and reporting the best
     # round (the box's demonstrated capability; every round's scaling
     # and ceiling stay in the row for the full picture).
-    model, params = build_model(spec)
+    model, params, _ = build_model(spec)
     nmax = max(replica_counts)
     max_rounds = 4 if nmax > 1 else 1
     sups = {}
@@ -1435,6 +1792,24 @@ def remat_sweep(iters: int = 8) -> list:
     return rows
 
 
+
+def _update_bench_serve_row(key: str, res) -> None:
+    """Load-modify-atomic-replace one row of BENCH_SERVE.json — the ONE
+    definition of the standalone bench flags' write discipline (six
+    flags share it; a divergent copy would silently fork the format)."""
+    path = os.path.join(os.path.dirname(__file__), "BENCH_SERVE.json")
+    doc = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            doc = json.load(f)
+    doc[key] = res
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("bench")
     ap.add_argument("--kernels", action="store_true",
@@ -1476,6 +1851,17 @@ def main(argv=None) -> int:
                          "off-vs-off control; updates the 'slo_scrape' "
                          "row of BENCH_SERVE.json in place (the full "
                          "--serve run includes it too)")
+    ap.add_argument("--serve-qmode", action="store_true",
+                    help="quantized-serving bench only: slots=8 trace at "
+                         "qmode off/int8/int4 (interleaved rounds); "
+                         "updates the 'qmode' row of BENCH_SERVE.json in "
+                         "place (the full --serve run includes it too)")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="prefix-cache bench only: 64 requests sharing a "
+                         "1k-token system prompt, cold vs warm store + "
+                         "direct admission cost; updates the "
+                         "'shared_prefix' row of BENCH_SERVE.json in "
+                         "place (the full --serve run includes it too)")
     ap.add_argument("--remat-sweep", action="store_true",
                     help="policy x skip operating-point sweep (VERDICT r4)")
     args = ap.parse_args(argv)
@@ -1499,17 +1885,7 @@ def main(argv=None) -> int:
 
         pin_compute_pool([0])
         res = bench_fleet()
-        path = os.path.join(os.path.dirname(__file__), "BENCH_SERVE.json")
-        doc = {}
-        if os.path.exists(path):
-            with open(path) as f:
-                doc = json.load(f)
-        doc["fleet"] = res
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=2)
-            f.write("\n")
-        os.replace(tmp, path)
+        _update_bench_serve_row("fleet", res)
         print(json.dumps({
             "metric": "fleet_tokens_per_sec_tiny",
             "rows": {k: v["tokens_per_sec"] for k, v in res["rows"].items()},
@@ -1524,17 +1900,7 @@ def main(argv=None) -> int:
 
     if args.obs_overhead:
         res = bench_obs_overhead()
-        path = os.path.join(os.path.dirname(__file__), "BENCH_SERVE.json")
-        doc = {}
-        if os.path.exists(path):
-            with open(path) as f:
-                doc = json.load(f)
-        doc["obs_overhead"] = res
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=2)
-            f.write("\n")
-        os.replace(tmp, path)
+        _update_bench_serve_row("obs_overhead", res)
         print(json.dumps({
             "metric": "serve_obs_overhead_tiny",
             "tokens_per_sec_off": res["tokens_per_sec_off"],
@@ -1543,19 +1909,35 @@ def main(argv=None) -> int:
         }))
         return 0
 
+    if args.serve_qmode:
+        res = bench_serve_qmode()
+        _update_bench_serve_row("qmode", res)
+        print(json.dumps({
+            "metric": "serve_qmode_tiny",
+            "tokens_per_sec": {m: res["rows"][m]["tokens_per_sec"]
+                               for m in res["rows"]},
+            "ms_per_tok_vs_off": {
+                m: res["rows"][m].get("ms_per_tok_vs_off")
+                for m in ("int8", "int4")
+            },
+        }))
+        return 0
+
+    if args.shared_prefix:
+        res = bench_shared_prefix()
+        _update_bench_serve_row("shared_prefix", res)
+        print(json.dumps({
+            "metric": "serve_shared_prefix_tiny",
+            "warm_over_cold_tokens_per_sec":
+                res.get("warm_over_cold_tokens_per_sec"),
+            "admit_cold_over_warm": res.get("admit_cold_over_warm"),
+            "slo_check": res.get("slo_check"),
+        }))
+        return 0
+
     if args.slo_scrape:
         res = bench_slo_scrape()
-        path = os.path.join(os.path.dirname(__file__), "BENCH_SERVE.json")
-        doc = {}
-        if os.path.exists(path):
-            with open(path) as f:
-                doc = json.load(f)
-        doc["slo_scrape"] = res
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=2)
-            f.write("\n")
-        os.replace(tmp, path)
+        _update_bench_serve_row("slo_scrape", res)
         print(json.dumps({
             "metric": "serve_slo_scrape_tiny",
             "tokens_per_sec_off": res["tokens_per_sec_off"],

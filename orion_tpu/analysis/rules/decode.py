@@ -31,6 +31,15 @@ function of ``serving/batching.py`` — one whose name contains ``admit``,
 sit on the scheduler's hot path and a per-admit device round-trip is the
 head-of-line stall the unified path exists to kill.
 
+ISSUE 11 adds ``prefix`` to the admission markers: the content-addressed
+prefix cache's lookup/stage/publish paths in the engine
+(``SlotEngine._prefix_lookup`` / ``_stage_prefix`` /
+``publish_pending_prefixes``) are admission code — a hit must cost hash +
+disk + ONE fused jitted row write, so any host sync in a *prefix*-named
+function of ``serving/batching.py`` is the same finding. The store-side
+serialization (publish's device_get) lives in
+``serving/prefix_store.py`` by design, off the engine's hot path.
+
 Scope: the decode modules only (``orion_tpu/serving/`` and
 ``generate.py``); host loops elsewhere (eval CLIs, data prep) may sync
 freely. Traced code is already covered by ``tracer-host``; this rule is
@@ -56,12 +65,12 @@ def _is_decode_module(path: str) -> bool:
     return "serving/" in path or path.endswith("generate.py")
 
 
-_ADMIT_MARKERS = ("admit", "insert", "stage")
+_ADMIT_MARKERS = ("admit", "insert", "stage", "prefix")
 
 
 def _inside_admission(node: ast.AST) -> bool:
     """Lexically inside an admission-path function of the engine (see
-    module docstring: names containing admit/insert/stage)."""
+    module docstring: names containing admit/insert/stage/prefix)."""
     cur = getattr(node, "_orion_parent", None)
     while cur is not None:
         if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
@@ -163,10 +172,12 @@ class DecodeHostSyncRule:
                 yield Finding(
                     self.id, ctx.path, node.lineno,
                     f"{sync} on the admission path (a function named "
-                    "*admit*/*insert*/*stage*): admission is an O(1) slot "
-                    "insert — stage the prompt into the carry and let the "
-                    "unified scan consume it; a per-admit host sync "
-                    "re-creates the head-of-line stall",
+                    "*admit*/*insert*/*stage*/*prefix*): admission is an "
+                    "O(1) slot insert — stage the prompt (or the cached "
+                    "prefix row) into the carry and let the unified scan "
+                    "consume it; a per-admit host sync re-creates the "
+                    "head-of-line stall (prefix-store serialization "
+                    "belongs in serving/prefix_store.py)",
                 )
         # the probe budget: ONE probe sync per chunk loop, slot count
         # notwithstanding (the continuous-batching scheduler contract)

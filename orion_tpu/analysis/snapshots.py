@@ -13,6 +13,11 @@ down, at what XLA actually compiled. Each target in
   the auto-sharded train step shows none of these).
 - ``scan_carry_bytes`` — byte size of the largest scan's carry (the
   decode target's O(1)-state budget in bytes).
+- ``dtype_counts``     — occurrences of each element-type token in the
+  optimized HLO (``s8[...]``, ``f32[...]``, ...): the artifact that pins
+  a quantized program's storage story — the int8/int4 decode targets
+  must show ``s8`` weight traffic while their scan carry stays the fp32
+  target's EXACT byte size (weights quantize, state never does).
 - ``flops`` / ``bytes_accessed`` — the compiler's own cost model.
 - ``donation``         — declared donated input buffers vs the aliases
   XLA accepted. A donated arg XLA refuses to alias silently doubles that
@@ -70,6 +75,22 @@ _HLO_COLLECTIVES = (
 
 def op_histogram(hlo_text: str) -> Dict[str, int]:
     return dict(sorted(collections.Counter(_OP_RE.findall(hlo_text)).items()))
+
+
+# element-type tokens as they appear in HLO shapes ("s8[128,64]{...}")
+_DTYPE_RE = re.compile(r"\b(pred|s4|u4|s8|u8|s16|u16|s32|u32|s64|u64|"
+                       r"bf16|f16|f32|f64)\[")
+
+
+def dtype_counts(hlo_text: str) -> Dict[str, int]:
+    """Shape-dtype token histogram of the optimized HLO — how often each
+    element type appears in an instruction shape. Coarse by design: it
+    pins that a quantized program actually streams int8 buffers (s8 > 0)
+    and that the fp32 program has none, without depending on how XLA
+    fuses the dequant convert into the dot."""
+    return dict(sorted(
+        collections.Counter(_DTYPE_RE.findall(hlo_text)).items()
+    ))
 
 
 def hlo_collective_counts(hlo_text: str) -> Dict[str, int]:
@@ -252,6 +273,57 @@ def _snap_decode_batched_prefill_tiny() -> Tuple[Any, Any, Dict[str, Any]]:
     return jaxpr, lowered, meta
 
 
+def _snap_decode_batched_quant(mode: str) -> Tuple[Any, Any, Dict[str, Any]]:
+    """The slot-multiplexed batched decode chunk compiled over the QUANT
+    model (``TransformerLM(cfg, quant=mode)``) at the same slots=8,
+    chunk=8 shape as ``decode_batched_tiny`` — the quantized-serving
+    artifact (ISSUE 11). Three pins: collectives stay zero, the scan
+    carry bytes are EXACTLY the fp32 target's (the carry is tokens +
+    decode state + bookkeeping; weights quantize, the carry must not
+    grow or shrink with qmode), and ``dtype_counts`` shows the s8 weight
+    traffic (int4 packs nibbles into s8 bytes too — halving shows up in
+    buffer SIZES, which the op/dtype mix reflects via the unpack ops)."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import SampleConfig, _decode_batched_chunk_jit
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM, init_decode_state
+
+    cfg = get_config("tiny")
+    model = TransformerLM(cfg, quant=mode)
+    slots, chunk = 8, 8
+    key = jax.random.PRNGKey(0)
+    prompt = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    params = jax.eval_shape(model.init, key, prompt)
+    states = jax.eval_shape(partial(init_decode_state, cfg, slots))
+    vec = lambda dt: jax.ShapeDtypeStruct((slots,), dt)  # noqa: E731
+    carry = (
+        vec(jnp.int32), states, vec(jnp.int32), vec(jnp.int32),
+        vec(jnp.bool_),
+    )
+    rngs = jax.ShapeDtypeStruct((slots, 2), jnp.uint32)
+    active = vec(jnp.bool_)
+    args = (model, params, carry, rngs, active, chunk, SampleConfig())
+    jaxpr = jax.make_jaxpr(
+        _decode_batched_chunk_jit, static_argnums=(0, 5, 6)
+    )(*args)
+    lowered = _decode_batched_chunk_jit.lower(*args)
+    meta = {"slots": slots, "chunk": chunk, "qmode": mode,
+            "donated_args": 0}
+    return jaxpr, lowered, meta
+
+
+def _snap_decode_batched_int8():
+    return _snap_decode_batched_quant("int8")
+
+
+def _snap_decode_batched_int4():
+    return _snap_decode_batched_quant("int4")
+
+
 # name -> () -> (closed_jaxpr, lowered, meta). Golden files live at
 # golden/<name>.json; adding a target here + --update-golden creates one.
 SNAPSHOT_TARGETS: Dict[str, Callable[[], Tuple[Any, Any, Dict[str, Any]]]] = {
@@ -259,6 +331,8 @@ SNAPSHOT_TARGETS: Dict[str, Callable[[], Tuple[Any, Any, Dict[str, Any]]]] = {
     "decode_tiny": _snap_decode_tiny,
     "decode_batched_tiny": _snap_decode_batched_tiny,
     "decode_batched_prefill_tiny": _snap_decode_batched_prefill_tiny,
+    "decode_batched_int8": _snap_decode_batched_int8,
+    "decode_batched_int4": _snap_decode_batched_int4,
 }
 
 
@@ -270,6 +344,7 @@ def build_snapshot(name: str) -> Dict[str, Any]:
         "target": name,
         **meta,
         "op_histogram": op_histogram(hlo),
+        "dtype_counts": dtype_counts(hlo),
         "hlo_collectives": hlo_collective_counts(hlo),
         "scan_carry_bytes": _carry_bytes(jaxpr),
         "donation": {
@@ -396,7 +471,8 @@ def audit_golden(
 
 __all__ = [
     "audit_golden", "build_snapshot", "diff_report", "donation_findings",
-    "op_histogram", "hlo_collective_counts", "alias_count", "write_golden",
+    "op_histogram", "dtype_counts", "hlo_collective_counts",
+    "alias_count", "write_golden",
     "golden_path", "SNAPSHOT_TARGETS", "GOLDEN_DIR", "ALL_GOLDEN_CHECKS",
     "RULE_DRIFT", "RULE_MISSING", "RULE_DONATION",
 ]

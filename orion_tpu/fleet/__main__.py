@@ -61,6 +61,20 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--prefill-buckets", default="pow2")
     p.add_argument("--replica-max-inflight", type=int, default=8,
                    help="per-replica admission queue bound")
+    p.add_argument("--qmode", choices=["off", "int8", "int4"],
+                   default="off",
+                   help="weight-streamed quantized serving inside EVERY "
+                        "replica (each child quantizes the same params "
+                        "the same deterministic way, so placement stays "
+                        "invisible in the tokens)")
+    p.add_argument("--prefix-dir", default=None,
+                   help="SHARED content-addressed prefix cache: a system "
+                        "prompt published by one replica admits O(suffix) "
+                        "on every replica (needs --prefill-chunk > 0)")
+    p.add_argument("--prefix-len", type=int, default=0,
+                   help="declare the first N tokens of every prompt as a "
+                        "shared cacheable prefix (miss publishes to "
+                        "--prefix-dir; 0 = never publish)")
     p.add_argument("--pin-cores", action="store_true",
                    help="pin each replica's XLA compute pool to one core "
                         "(rotating by replica index) — without it one "
@@ -132,6 +146,13 @@ def _spec_from_args(args) -> ReplicaSpec:
         "deadline_ms": args.deadline_ms,
         "grace": args.grace,
         "session_dir": args.session_dir,
+        "qmode": args.qmode,
+        "prefix_dir": args.prefix_dir,
+        # params_id is NOT set here: every replica derives it from the
+        # weights it actually loads (build_model — config + overrides +
+        # resolved checkpoint STEP or init seed), so a fleet restarted
+        # after training advanced can never hit a previous step's
+        # prefix snapshots
     }
     if args.slo_latency_ms > 0:
         # declared objectives (JSON-able Objective kwargs) arm actuation
@@ -206,11 +227,13 @@ def main(argv=None) -> int:
         )
 
     if args.local:
-        model, params = build_model(spec)
+        model, params, params_id = build_model(spec)
 
         def factory(name: str):
             return LocalReplica(
-                model, params, serve_config(_spec_for(name)), name=name
+                model, params,
+                serve_config(_spec_for(name), params_id=params_id),
+                name=name,
             ).start()
     else:
         import os
@@ -267,6 +290,7 @@ def main(argv=None) -> int:
                 prompt=np.asarray([tok.encode(line)], np.int32).reshape(1, -1),
                 max_new_tokens=args.max_new_tokens,
                 sample=sample, seed=args.seed + i, session_id=sid,
+                prefix_len=max(args.prefix_len, 0),
             )
             while True:
                 try:

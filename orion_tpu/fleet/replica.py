@@ -71,7 +71,11 @@ class ReplicaSpec:
     JSON-able value: the model (config name + field overrides + either a
     seeded random init or a checkpoint) and the ServeConfig knobs. Every
     replica of a fleet gets the SAME spec — identical params are what
-    make dispatch placement invisible in the tokens.
+    make dispatch placement invisible in the tokens. Quantized serving
+    and the prefix cache ride the ``serve`` dict (``qmode``,
+    ``prefix_dir``, ``params_id`` — every child quantizes the same fp32
+    params the same deterministic way, and a shared ``prefix_dir`` means
+    a prefix published by one replica admits O(suffix) on all of them).
 
     ``faults``: chaos-only — fault-plan entries armed INSIDE the child
     (e.g. ``[{"kind": "poison_decode_state_at", "args": [1, -1]}]``), so
@@ -133,15 +137,23 @@ def pin_compute_pool(cpus: List[int]) -> None:
 
 
 def build_model(spec: ReplicaSpec):
-    """(model, params) for a replica: the named config with field
-    overrides applied, params from the checkpoint when given, else a
-    deterministic seeded init (identical across every process that runs
-    this function with the same spec)."""
+    """(model, params, params_id) for a replica: the named config with
+    field overrides applied, params from the checkpoint when given, else
+    a deterministic seeded init (identical across every process that
+    runs this function with the same spec).
+
+    ``params_id`` is the weights' provenance for prefix-cache addressing
+    — config + overrides + (checkpoint dir AND the step a default-latest
+    load actually RESOLVED to, or the init seed). The resolved step must
+    ride the id: a fleet restarted after training advanced loads newer
+    weights, and hitting the previous step's prefix snapshots would
+    silently serve stale state (serving/prefix_store.py)."""
     import jax
     import jax.numpy as jnp
 
     from orion_tpu.models.configs import get_config
     from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.serving.prefix_store import overrides_fingerprint
 
     cfg = get_config(spec.config)
     if spec.overrides:
@@ -152,6 +164,7 @@ def build_model(spec: ReplicaSpec):
             k: (tuple(v) if isinstance(v, list) else v)
             for k, v in spec.overrides.items()
         })
+    ov = overrides_fingerprint(spec.overrides)
     if spec.ckpt_dir:
         from orion_tpu.generate import (
             adapt_config_to_params,
@@ -159,22 +172,29 @@ def build_model(spec: ReplicaSpec):
             unstack_if_pipeline,
         )
 
-        params, _ = load_params(spec.ckpt_dir)
+        params, step = load_params(spec.ckpt_dir)
         cfg = adapt_config_to_params(cfg, params)
         model = TransformerLM(cfg)
         params, _ = unstack_if_pipeline(model, params)
-        return model, params
+        pid = f"{spec.config}:ov={ov}:ckpt={spec.ckpt_dir}:step={step}"
+        return model, params, pid
     model = TransformerLM(cfg)
     params = model.init(
         jax.random.PRNGKey(spec.init_seed), jnp.zeros((1, 8), jnp.int32)
     )
-    return model, params
+    return model, params, f"{spec.config}:ov={ov}:seed={spec.init_seed}"
 
 
-def serve_config(spec: ReplicaSpec):
+def serve_config(spec: ReplicaSpec, params_id: Optional[str] = None):
+    """ServeConfig from the spec; ``params_id`` (from
+    :func:`build_model`) fills the prefix-addressing identity unless the
+    spec pinned one explicitly."""
     from orion_tpu.serving.server import ServeConfig
 
-    return ServeConfig(**(spec.serve or {}))
+    cfg = ServeConfig(**(spec.serve or {}))
+    if params_id and not cfg.params_id:
+        cfg = dataclasses.replace(cfg, params_id=params_id)
+    return cfg
 
 
 # -- wire helpers -------------------------------------------------------------
@@ -222,6 +242,7 @@ def _request_to_wire(request: DecodeRequest) -> Dict[str, Any]:
         "seed": int(request.seed),
         "deadline_ms": float(request.deadline_ms),
         "session_id": request.session_id,
+        "prefix_len": int(request.prefix_len),
     }
 
 
@@ -235,6 +256,7 @@ def _request_from_wire(msg: Dict[str, Any]) -> DecodeRequest:
         seed=int(msg.get("seed", 0)),
         deadline_ms=float(msg.get("deadline_ms", 0.0)),
         session_id=msg.get("session_id"),
+        prefix_len=int(msg.get("prefix_len", 0)),
     )
 
 
@@ -826,8 +848,8 @@ def _child_main() -> int:
         for entry in spec.faults:
             getattr(plan, entry["kind"])(*entry.get("args", []))
 
-    model, params = build_model(spec)
-    server = Server(model, params, serve_config(spec))
+    model, params, params_id = build_model(spec)
+    server = Server(model, params, serve_config(spec, params_id=params_id))
     watchers: List[threading.Thread] = []
 
     def watch(rid: int, pending) -> None:

@@ -57,8 +57,25 @@ def quantize_int4_packed(w: Array, reduce_axes=(0,)) -> tuple[Array, Array]:
     (_unpack_nibbles: two arithmetic shifts + interleave) is elementwise on
     the weight read, which XLA fuses into the dot exactly like the int8
     convert (module docstring)."""
-    assert reduce_axes == (0,), "packed int4 is defined for [in, out] kernels"
-    assert w.shape[0] % 2 == 0, w.shape
+    if reduce_axes != (0,):
+        raise ValueError(
+            f"packed int4 is defined for [in, out] kernels reduced over "
+            f"axis 0; got reduce_axes={reduce_axes!r}"
+        )
+    if w.ndim != 2:
+        raise ValueError(
+            f"quantize_int4_packed takes a 2-D [in, out] kernel; got "
+            f"shape {w.shape}"
+        )
+    if w.shape[0] % 2 != 0:
+        # an odd input dim cannot pack two nibbles per byte; truncating or
+        # padding silently would mis-shape the dequant (half the rows
+        # would dot against the wrong nibble) — refuse loudly instead
+        raise ValueError(
+            f"quantize_int4_packed needs an even input dim (two nibbles "
+            f"share a byte along axis 0); got d_in={w.shape[0]} "
+            f"(shape {w.shape}). Keep such layers int8."
+        )
     w = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(w), axis=0, keepdims=True)
     s = jnp.maximum(amax, 1e-12) / 7.0
@@ -120,8 +137,35 @@ def q4_matmul(x: Array, p: Array, s: Array, block_out: int = 512,
     dots: ~1.7x int8). Decode-path only (no VJP)."""
     from jax.experimental import pallas as pl
 
+    if x.ndim != 2 or p.ndim != 2:
+        raise ValueError(
+            f"q4_matmul takes x [B, d] and packed p [d/2, out]; got "
+            f"x{tuple(x.shape)}, p{tuple(p.shape)}"
+        )
     b, d = x.shape
     out = p.shape[1]
+    if d % 2 != 0:
+        raise ValueError(
+            f"q4_matmul needs an even contraction dim (x splits into "
+            f"even/odd nibble lanes); got d={d}"
+        )
+    if p.shape[0] * 2 != d:
+        raise ValueError(
+            f"packed kernel rows {p.shape[0]} != d/2 = {d // 2}: the "
+            "packed buffer does not match this activation width"
+        )
+    if s.shape != (out,):
+        raise ValueError(
+            f"scale shape {tuple(s.shape)} != ({out},): one fp32 scale "
+            "per output channel"
+        )
+    if block_out <= 0 or block_out % 128 != 0:
+        # the grid pads `out` up to a block multiple and the Mosaic specs
+        # tile lanes in 128s — a non-multiple block would silently be
+        # rounded, making the caller's tuning knob a lie
+        raise ValueError(
+            f"block_out must be a positive multiple of 128; got {block_out}"
+        )
     # the i32-widened unpack temps are (d/2, block_out) x2 in VMEM; cap
     # them ~4MB each so wide contractions (7B's 11008-wide down proj)
     # stay under the 16MB stack
@@ -169,7 +213,11 @@ class Int4Dense(nn.Module):
     @nn.compact
     def __call__(self, x: Array) -> Array:
         d_in = x.shape[-1]
-        assert d_in % 2 == 0, d_in
+        if d_in % 2 != 0:
+            raise ValueError(
+                f"Int4Dense needs an even input dim (nibble packing); got "
+                f"d_in={d_in} — keep this layer Int8Dense instead"
+            )
         p = self.param(
             "kernel_p4",
             nn.initializers.zeros_init(),

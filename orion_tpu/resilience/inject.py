@@ -45,6 +45,14 @@ Hook sites wired today:
 ``"serve.session_load"``  serving/session_store.py SessionStore.load, inside
                           the retried read of one session generation
                           (step = the generation number)
+``"serve.prefix_save"``   serving/prefix_store.py PrefixStore.publish, inside
+                          the retried write of one prefix generation
+                          (step = the generation number) — a kill here must
+                          leave the previous generation the newest committed
+``"serve.prefix_load"``   serving/prefix_store.py PrefixStore lookup, inside
+                          the retried read of one candidate generation
+                          (step = the generation number) — a fault here must
+                          fall back to a cold prefill, never fail the request
 ``"fleet.dispatch"``      fleet/router.py Router.submit, before each
                           replica-placement attempt (step = the fleet-wide
                           dispatch ordinal) — an injected fault here fails
@@ -103,6 +111,10 @@ SITES = {
     "decode.state_nan": "DecodeSession decode-state poisoning marker",
     "serve.session_save": "serving/session_store.py save, inside retry",
     "serve.session_load": "serving/session_store.py load, inside retry",
+    "serve.prefix_save": "serving/prefix_store.py publish, inside the "
+                         "retried write of one prefix generation",
+    "serve.prefix_load": "serving/prefix_store.py lookup, inside the "
+                         "retried read of one candidate generation",
     "fleet.dispatch": "fleet/router.py submit, before each placement "
                       "attempt (step = fleet-wide dispatch ordinal)",
     "fleet.replica_spawn": "fleet/supervisor.py _spawn, inside the spawn "

@@ -20,6 +20,11 @@ training resilience stack (orion_tpu/resilience/, PR 2).
   one O(1) decode-state snapshot, persisted atomically with a per-leaf
   crc32 manifest and restored bitwise (``--session-dir``; survives
   SIGTERM drain and server restarts).
+- :mod:`prefix_store` — the content-addressed prefix cache: a shared
+  prompt prefix (system prompt) is ONE O(1) decode-state snapshot keyed
+  by hash(params identity, qmode, token bytes); a hit admits as a row
+  copy + in-scan prefill of only the uncached suffix (``--prefix-dir``;
+  shared by every replica of a fleet).
 
 ``python -m orion_tpu.serving`` is the CLI (``--slots``, ``--chunk``,
 ``--deadline-ms``, ``--max-inflight``, ``--prefill-buckets``; see README
@@ -43,6 +48,7 @@ from orion_tpu.serving.session import (
     DecodeSession,
     LadderExhausted,
 )
+from orion_tpu.serving.prefix_store import PrefixEntry, PrefixStore
 from orion_tpu.serving.session_store import (
     SessionIntegrityError,
     SessionState,
@@ -55,4 +61,5 @@ __all__ = [
     "load_tokenizer", "SlotEngine", "parse_buckets",
     "DecodeRequest", "DecodeResult", "DecodeSession", "LadderExhausted",
     "SessionStore", "SessionState", "SessionIntegrityError",
+    "PrefixStore", "PrefixEntry",
 ]

@@ -77,6 +77,27 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--stall-timeout", type=float, default=0.0,
                    help="watchdog heartbeat budget per decode chunk "
                         "(0 = off); must exceed compile + one chunk")
+    p.add_argument("--qmode", choices=["off", "int8", "int4"],
+                   default="off",
+                   help="weight-streamed quantized serving: the loaded "
+                        "params are quantized ONCE at startup (int8 "
+                        "quarters each decode step's weight bytes, int4 "
+                        "halves them again; per-out-channel scales, "
+                        "orion_tpu/quant.py) and every bitwise serving "
+                        "contract holds per mode")
+    p.add_argument("--prefix-dir", default=None,
+                   help="content-addressed prefix cache root: a shared "
+                        "prompt prefix (system prompt) is one O(1) "
+                        "decode-state snapshot — a hit admits at "
+                        "O(suffix) instead of O(prompt); replicas "
+                        "sharing the directory share the cache. Needs "
+                        "--prefill-chunk > 0")
+    p.add_argument("--prefix-len", type=int, default=0,
+                   help="declare the first N tokens of every prompt as a "
+                        "shared cacheable prefix: a miss publishes its "
+                        "(chunk-aligned) snapshot to --prefix-dir so "
+                        "later requests hit (lookups need no "
+                        "declaration; 0 = never publish)")
     p.add_argument("--session-dir", default=None,
                    help="durable-session store root: conversations "
                         "suspend to one O(1) state snapshot at turn end "
@@ -173,18 +194,34 @@ def _run(args, guard) -> int:
     if args.tokenizer and args.eos:
         eos_token = tok.eos
 
+    # prefix/session addressing must pin the WEIGHTS' provenance, not
+    # just the config name: the checkpoint step a default-latest load
+    # resolves to and the --set overrides are part of what the weights
+    # ARE — two checkpoints (or two override sets) sharing a prefix_dir
+    # must never resolve to each other's states. The fingerprint is the
+    # SHARED definition (prefix_store.overrides_fingerprint) over the
+    # PARSED overrides, so this CLI and a fleet replica built from the
+    # same config + --set derive the same identity and share entries.
+    from orion_tpu.serving.prefix_store import overrides_fingerprint
+    from orion_tpu.utils.config import parse_set_overrides as _parse_ov
+
+    ov = overrides_fingerprint(_parse_ov(args.set) if args.set else {})
     if args.ckpt_dir:
         params, step = load_params(args.ckpt_dir, retry=retry)
         cfg = adapt_config_to_params(cfg, params)
         print(f"serving step {step} from {args.ckpt_dir}", file=sys.stderr)
         model = TransformerLM(cfg)
         params, _ = unstack_if_pipeline(model, params)
+        params_id = (
+            f"{args.config}:ov={ov}:ckpt={args.ckpt_dir}:step={step}"
+        )
     else:
         model = TransformerLM(cfg)
         params = model.init(
             jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
         )
         print("no --ckpt-dir: random params (smoke test)", file=sys.stderr)
+        params_id = f"{args.config}:ov={ov}:seed=0"
     if args.tokenizer:
         # after cfg adaptation: out-of-vocab ids would be silently clamped
         # by the embedding gather — garbage served with status 'ok'
@@ -233,6 +270,8 @@ def _run(args, guard) -> int:
             prefill_chunk=args.prefill_chunk,
             prompt_overflow=args.prompt_overflow,
             session_dir=args.session_dir, session_idle_s=args.session_idle_s,
+            qmode=args.qmode, prefix_dir=args.prefix_dir,
+            params_id=params_id,
             metrics_path=args.metrics_path,
             metrics_interval_s=args.metrics_interval_s,
             trace_path=args.trace_path, flight_dir=args.flight_dir,
@@ -264,6 +303,7 @@ def _run(args, guard) -> int:
             sample=sample,
             seed=args.seed + i,
             session_id=sid,
+            prefix_len=max(args.prefix_len, 0),
         )
         try:
             completed.append((line, server.submit(req)))
@@ -305,8 +345,16 @@ def _run(args, guard) -> int:
     mode = (f"in-scan prefill, {server.engine.prefill_chunk} tok/boundary"
             if args.prefill_chunk else "host prefill")
     print(f"slot occupancy: {server.occupancy_lifetime():.3f} "
-          f"({args.slots} slot(s), chunk {args.chunk}, {mode})",
+          f"({args.slots} slot(s), chunk {args.chunk}, {mode}"
+          + (f", qmode {args.qmode}" if args.qmode != "off" else "")
+          + ")",
           file=sys.stderr)
+    if args.prefix_dir:
+        flat = server.metrics.counters_flat()
+        print(f"prefix cache: {flat.get('prefix_hits', 0)} hit(s), "
+              f"{flat.get('prefix_misses', 0)} miss(es), "
+              f"{flat.get('prefix_publishes', 0)} publish(es)",
+              file=sys.stderr)
     if args.metrics_path:
         print(f"metrics: {args.metrics_path} (+ .json)", file=sys.stderr)
     if args.trace_path:

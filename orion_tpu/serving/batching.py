@@ -142,6 +142,33 @@ def _stage_prompt_carry(carry, rngs, plen, pfold, pbuf, row, rng, i,
 
 
 @jax.jit
+def _stage_prefix_carry(carry, rngs, plen, pfold, pbuf, st1, row, rng, i,
+                        length, fold, t0):
+    """O(suffix) in-scan admission on a prefix-cache HIT: slot ``i`` gets
+    the cached prefix's decode-state row (``st1``, batch 1 — the
+    ``insert_decode_slot`` snapshot copy that IS the prefix cache) at
+    position ``t0 = len(prefix)``, with the FULL padded prompt parked in
+    the staging buffer and ``plen`` the full prompt length. The unified
+    chunk program consumes from ``t`` onward, i.e. exactly the uncached
+    suffix ``prompt[t0:]`` — no new device program, no host sync, one
+    fused row write (the same shape as :func:`_stage_prompt_carry` plus
+    the state insert)."""
+    token, states, t, emit, done = carry
+    states = insert_decode_slot(states, st1, i)
+    new_carry = (
+        token.at[i].set(0),
+        states,
+        t.at[i].set(t0),
+        emit.at[i].set(fold),
+        done.at[i].set(False),
+    )
+    return (
+        new_carry, rngs.at[i].set(rng), plen.at[i].set(length),
+        pfold.at[i].set(fold), pbuf.at[i].set(row),
+    )
+
+
+@jax.jit
 def _restart_prefill_row(carry, i):
     """Ladder rung 2 for a slot still MID-prefill: zero its state row and
     rewind its position to 0 so the in-scan prefill replays from scratch
@@ -253,6 +280,7 @@ class SlotEngine:
         prefill_chunk: int = 0,
         prompt_overflow: str = "error",
         on_event: Optional[Callable[[str, dict], None]] = None,
+        prefix_store: Optional[Any] = None,
     ):
         assert slots > 0, slots
         assert chunk > 0, chunk
@@ -279,6 +307,10 @@ class SlotEngine:
         # slot — no host-side prefill call, no head-of-line stall. 0 =
         # the legacy host-prefill admission (the bench's comparison path).
         self.prefill_chunk = 0
+        # the linear-attention chunk the in-scan piece boundaries align
+        # to — also the prefix store's entry alignment (a cached state at
+        # a non-chunk position could not extend bitwise)
+        self.chunk_align = 0
         if prefill_chunk:
             from orion_tpu.ops.dispatch import resolve, resolve_chunk
 
@@ -299,6 +331,16 @@ class SlotEngine:
             c = resolve_chunk(cfg.chunk, cfg.max_seq_len,
                               resolve(cfg.backend))
             self.prefill_chunk = -(-int(prefill_chunk) // c) * c
+            self.chunk_align = c
+        # content-addressed prefix cache (serving/prefix_store.py): a hit
+        # stages the cached state row at its position and in-scan
+        # prefills only the suffix — O(prompt) admission becomes
+        # O(suffix). Lookup/publish are hash + disk only on this side;
+        # the store owns the (publish-side) serialization syncs.
+        self.prefix_store = None
+        if prefix_store is not None:
+            self.attach_prefix_store(prefix_store)
+        self._pending_prefix: List[Tuple[str, Any]] = []  # (key, tokens)
         self._sample: Optional[SampleConfig] = None  # set by first admit
         self._slots: List[Optional[_Slot]] = [None] * self.slots
         self._chunk_counter = 0  # global boundary index (serve.chunk hook)
@@ -325,6 +367,26 @@ class SlotEngine:
     def _emit(self, kind: str, **fields) -> None:
         if self._on_event is not None:
             self._on_event(kind, fields)
+
+    def attach_prefix_store(self, store) -> None:
+        """Wire a :class:`~orion_tpu.serving.prefix_store.PrefixStore`.
+        Requires in-scan prefill (the hit path IS "stage with the cached
+        state at position t0 and let the scan consume the suffix" — the
+        host-prefill admission path has no staging to ride) and an entry
+        alignment on this engine's linear-attention chunk boundaries."""
+        if not self.prefill_chunk:
+            raise ValueError(
+                "the prefix cache rides in-scan prefill (a hit stages the "
+                "cached state and scan-consumes only the suffix); set "
+                "prefill_chunk > 0 or drop the prefix store"
+            )
+        if store.align % self.chunk_align != 0:
+            raise ValueError(
+                f"prefix store alignment {store.align} is not a multiple "
+                f"of the linear-attention chunk {self.chunk_align}: "
+                "entries at non-chunk positions cannot extend bitwise"
+            )
+        self.prefix_store = store
 
     # -- occupancy ------------------------------------------------------------
 
@@ -436,11 +498,20 @@ class SlotEngine:
             session_id = request.session_id
         seed = request.seed if seed is None else seed
         rng = jax.random.PRNGKey(seed)
+        remaining = prompt.shape[1] if self.prefill_chunk else 0
         if self.prefill_chunk:
             # O(1) in-scan admission: no prefill here — the prompt is
             # staged into the carry and consumed prefill_chunk tokens per
-            # boundary inside the batched scan
-            self._stage_inscan(i, prompt, rng, sample_index)
+            # boundary inside the batched scan. With a prefix store, a
+            # content hit stages the cached state row at its position
+            # instead, so the scan consumes only the uncached suffix.
+            entry = self._prefix_lookup(request, prompt, tag)
+            if entry is not None:
+                self._stage_prefix(i, prompt, rng, sample_index, entry)
+                remaining = prompt.shape[1] - entry.t
+            else:
+                self._stage_inscan(i, prompt, rng, sample_index)
+                self._queue_prefix_publish(request, int(prompt.shape[1]))
         else:
             sub = prefill_carry(
                 self.model, self.params, prompt, self._sample, rng,
@@ -453,7 +524,7 @@ class SlotEngine:
             deadline_at=deadline_at,
             prompt=prompt,
             toks=[],
-            prompt_remaining=prompt.shape[1] if self.prefill_chunk else 0,
+            prompt_remaining=remaining,
             session_id=session_id,
             seed=seed,
             target_new=request.max_new_tokens,
@@ -497,12 +568,10 @@ class SlotEngine:
             "newest bucket-sized context with prompt_overflow='clamp'"
         )
 
-    def _stage_inscan(self, i: int, prompt: Array, rng: Array,
-                      sample_index: int) -> None:
-        """Stage one prompt for in-scan consumption: grow the staging
-        buffer to the prompt's bucket if needed (widths take bucket
-        values only — the unified program's compile key stays bounded),
-        then one fused row write (:func:`_stage_prompt_carry`)."""
+    def _staged_row(self, prompt: Array) -> Array:
+        """Grow the staging buffer to the prompt's bucket if needed
+        (widths take bucket values only — the unified program's compile
+        key stays bounded) and return the prompt as a buffer-width row."""
         b = bucket_for(prompt.shape[1], self.buckets)
         width = 0 if self._pbuf is None else self._pbuf.shape[1]
         if b > width:
@@ -513,13 +582,151 @@ class SlotEngine:
                     self._pbuf, ((0, 0), (0, b - width))
                 )
             width = b
-        row = jnp.pad(prompt, ((0, 0), (0, width - prompt.shape[1])))[0]
+        return jnp.pad(prompt, ((0, 0), (0, width - prompt.shape[1])))[0]
+
+    def _stage_inscan(self, i: int, prompt: Array, rng: Array,
+                      sample_index: int) -> None:
+        """Stage one prompt for in-scan consumption: one fused row write
+        (:func:`_stage_prompt_carry`)."""
+        row = self._staged_row(prompt)
         (self._carry, self._rngs, self._plen, self._pfold,
          self._pbuf) = _stage_prompt_carry(
             self._carry, self._rngs, self._plen, self._pfold, self._pbuf,
             row, rng, jnp.int32(i), jnp.int32(prompt.shape[1]),
             jnp.int32(sample_index),
         )
+
+    # -- content-addressed prefix cache (serving/prefix_store.py) -------------
+    # Everything on this side of the store boundary is hash + disk + one
+    # fused jitted dispatch — the decode-host-sync lint's admission scope
+    # covers *prefix*-named functions of this module, so the store owns
+    # any host<->device serialization (publish-side device_get).
+
+    def _prefix_lookup(self, request: DecodeRequest, prompt: Array, tag):
+        """Longest cached aligned prefix of this request's prompt, or
+        None. The lookup keys off the REQUEST's host tokens (the Server
+        normalizes prompts to host arrays at submit, off the scheduler
+        thread); a clamped prompt (overflow mode) skips the lookup —
+        its served tokens differ from the submitted ones."""
+        if self.prefix_store is None:
+            return None
+        raw = request.prompt
+        if getattr(raw, "ndim", 2) == 1:
+            raw = raw.reshape(1, -1)
+        if raw.shape[-1] != prompt.shape[1]:
+            # clamped: the served prompt is not the submitted one, so no
+            # lookup runs — still a MISS for the hit-rate denominator
+            # (these are exactly the longest prompts, which always pay
+            # the cold prefill; hiding them would inflate the ratio)
+            self._emit("prefix_miss", tag=tag,
+                       prompt_len=int(prompt.shape[1]), clamped=True)
+            return None
+        entry = self.prefix_store.lookup(
+            raw, declared=max(request.prefix_len, 0)
+        )
+        if entry is not None and entry.t % max(self.chunk_align, 1) != 0:
+            entry = None  # foreign alignment: unusable for in-scan pieces
+        if entry is None:
+            self._emit("prefix_miss", tag=tag,
+                       prompt_len=int(prompt.shape[1]))
+            return None
+        self._emit("prefix_hit", tag=tag, prefix_len=int(entry.t),
+                   suffix=int(prompt.shape[1]) - int(entry.t),
+                   key=entry.key, generation=int(entry.generation))
+        return entry
+
+    def _stage_prefix(self, i: int, prompt: Array, rng: Array,
+                      sample_index: int, entry) -> None:
+        """O(suffix) admission on a prefix hit: the FULL prompt is staged
+        (so the ladder's restart rung can replay from scratch) but the
+        carry row starts at ``t = entry.t`` with the cached state — one
+        fused row write, the snapshot copy that IS the prefix cache."""
+        row = self._staged_row(prompt)
+        (self._carry, self._rngs, self._plen, self._pfold,
+         self._pbuf) = _stage_prefix_carry(
+            self._carry, self._rngs, self._plen, self._pfold, self._pbuf,
+            entry.state, row, rng, jnp.int32(i),
+            jnp.int32(prompt.shape[1]), jnp.int32(sample_index),
+            jnp.int32(entry.t),
+        )
+
+    def _queue_prefix_publish(self, request: DecodeRequest,
+                              prompt_len: int) -> None:
+        """A miss on a request DECLARING a shared prefix queues that
+        aligned prefix for publication (deduped by content key; skipped
+        when another replica already committed it). The actual prefill +
+        store write runs via :meth:`publish_pending_prefixes` — outside
+        the admission hot path."""
+        if self.prefix_store is None or request.prefix_len <= 0:
+            return
+        pub = self.prefix_store.publish_length(
+            prompt_len, request.prefix_len
+        )
+        if pub <= 0:
+            return
+        raw = request.prompt
+        if getattr(raw, "ndim", 2) == 1:
+            raw = raw.reshape(1, -1)
+        row = raw[:, :pub]
+        key = self.prefix_store.key_for(row)
+        if any(k == key for k, _ in self._pending_prefix):
+            return
+        if self.prefix_store.generations(key):
+            return  # already committed (here or on another replica)
+        self._pending_prefix.append((key, row))
+
+    @property
+    def has_pending_prefixes(self) -> bool:
+        """Queued publishes awaiting :meth:`publish_pending_prefixes` —
+        the Server checks this to beat its watchdog first (a publish is
+        a solo prefill + possibly a fresh bucket compile, the same cost
+        class admission beats for)."""
+        return bool(self._pending_prefix)
+
+    def publish_pending_prefixes(self) -> int:
+        """Publish queued prefix snapshots: prefill the prefix solo (the
+        bucketed host-prefill compile, one per bucket) and hand the
+        state to the store, which serializes on its side. A failed
+        publish degrades to "not cached" with a warning — the cache must
+        never fail the serving path. Returns how many entries written.
+
+        Cost honesty: this runs on the scheduler thread between chunk
+        boundaries, so the FIRST declared novel prefix stalls co-resident
+        slots for one solo prefill (+ a first-time bucket compile) — a
+        one-time cost per (prefix, store) that every later hit on every
+        replica amortizes. It cannot ride the cold request's own in-scan
+        prefill: pieces advance ``t`` by ``prefill_chunk`` steps, so the
+        scan's state never sits exactly at the declared aligned length
+        to be extracted for free (and the publish must not change the
+        piece schedule, which is part of the bitwise contract)."""
+        done = 0
+        while self._pending_prefix:
+            key, row = self._pending_prefix.pop(0)
+            try:
+                if self.prefix_store.generations(key):
+                    # another replica committed it since queue time: the
+                    # re-check is one listdir, the prefill it saves is
+                    # the whole stall this path costs
+                    continue
+                carry = prefill_carry(
+                    self.model, self.params, row, self._sample,
+                    jax.random.PRNGKey(0), buckets=self.buckets,
+                )
+                gen = self.prefix_store.publish(row, carry[1])
+                if gen is None:
+                    continue  # raced: a peer committed mid-prefill
+                done += 1
+                self._emit("prefix_publish", key=key,
+                           length=int(row.shape[1]), generation=gen)
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"prefix publish failed ({type(e).__name__}: {e}); "
+                    "serving continues uncached",
+                    stacklevel=2,
+                )
+        return done
 
     def resume(
         self,

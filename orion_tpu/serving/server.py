@@ -112,6 +112,28 @@ class ServeConfig:
     # prompts longer than the largest prefill bucket: "error" refuses the
     # request cleanly; "clamp" serves the newest bucket-sized context
     prompt_overflow: str = "error"
+    # -- quantized serving (orion_tpu/quant.py): "off" | "int8" | "int4".
+    # The fp32 params handed to the Server are quantized ONCE at
+    # construction (per-out-channel scales, weights stored int8 /
+    # nibble-packed int4) and shared by every slot — each decode step
+    # then streams 1/4 (1/8) of the fp32 weight bytes. The state stays
+    # fp32/bf16 (only weights quantize), so every bitwise contract —
+    # batched-vs-solo parity, ladder rewind, session suspend/resume,
+    # in-scan == host prefill — holds unchanged PER qmode: quantization
+    # changes the numbers, never the determinism.
+    qmode: str = "off"
+    # -- content-addressed prefix cache (serving/prefix_store.py);
+    # None = disabled. Needs in-scan prefill (prefill_chunk > 0): a hit
+    # admits as one cached-state row copy + in-scan prefill of only the
+    # uncached suffix — O(prompt) admission becomes O(suffix). Shared by
+    # every replica pointing at the same directory.
+    prefix_dir: Optional[str] = None
+    prefix_keep: int = 2  # retained generations per prefix entry
+    # identity of the WEIGHTS for prefix-cache addressing (config name +
+    # checkpoint step / init seed). None = a config-hash default — fine
+    # for one model per store, but pin it when several checkpoints of
+    # one config share a prefix_dir (the CLIs do).
+    params_id: Optional[str] = None
     # -- durable sessions (session_store.py); None = sessions disabled --
     session_dir: Optional[str] = None  # on-disk session store root
     session_idle_s: float = 300.0  # resident-cache idle eviction (0 = off)
@@ -226,6 +248,30 @@ class Server:
 
         self.cfg = cfg
         self._clock = clock
+        # quantized serving: quantize ONCE here, before any engine or jit
+        # wrapper sees the params — every slot then shares the same
+        # int8/int4 tree, and the jit caches key on the quant model, so
+        # the engine's lifetime still costs one decode compile per
+        # (slots, chunk, bucket, qmode)
+        self.qmode = (cfg.qmode or "off").lower()
+        if self.qmode not in ("off", "int8", "int4"):
+            raise ValueError(
+                f"qmode must be one of off|int8|int4, got {cfg.qmode!r}"
+            )
+        if self.qmode != "off":
+            model, params = _gen.quantize_for_decode(
+                model, params, mode=self.qmode
+            )
+        # the weights' identity stamps BOTH stores: prefix entries are
+        # keyed by it (content addressing) and session generations carry
+        # it (a suspended state resumed under different weights or qmode
+        # would silently diverge — the store refuses the mismatch)
+        from orion_tpu.serving.prefix_store import params_identity
+
+        self.params_id = cfg.params_id or params_identity(
+            model.cfg, self.qmode
+        )
+        self._weights_identity = f"{self.params_id}|{self.qmode}"
         # ONE reentrant lock guards the metrics registry AND the health
         # machine: `snapshot()` reads both under a single acquisition, so
         # a fleet router polling /healthz can never observe a torn pair
@@ -272,6 +318,28 @@ class Server:
             prompt_overflow=cfg.prompt_overflow,
             on_event=self._on_engine_event,
         )
+        # content-addressed prefix cache: one store per prefix_dir,
+        # shared across replicas; entries are aligned to the engine's
+        # linear-attention chunk so a hit's suffix pieces stay on the
+        # in-scan bitwise contract
+        self.prefix_store = None
+        self._c_prefix_hits = self.metrics.counter("prefix_hits")
+        self._c_prefix_misses = self.metrics.counter("prefix_misses")
+        self._c_prefix_publishes = self.metrics.counter("prefix_publishes")
+        self._c_prefix_bytes = self.metrics.counter("prefix_bytes")
+        self._h_prefix_load_ms = self.metrics.histogram("prefix_load_ms")
+        self._h_prefix_save_ms = self.metrics.histogram("prefix_save_ms")
+        if cfg.prefix_dir:
+            from orion_tpu.serving.prefix_store import PrefixStore
+
+            self.prefix_store = PrefixStore(
+                cfg.prefix_dir, params_id=self.params_id, qmode=self.qmode,
+                align=max(self.engine.chunk_align, 1),
+                keep=cfg.prefix_keep,
+                should_abort=lambda: not self.health.accepting,
+                observer=self._on_prefix_io, clock=clock,
+            )
+            self.engine.attach_prefix_store(self.prefix_store)
         # the gauges we used to fly blind on — all callable (evaluated at
         # scrape time from live host state) and all free: queue depth,
         # per-slot prefill-vs-decode occupancy, compile-cache sizes
@@ -309,6 +377,7 @@ class Server:
                 # backing off on session I/O (resilience/retry.py)
                 should_abort=lambda: not self.health.accepting,
                 observer=self._on_store_io, clock=clock,
+                identity=self._weights_identity,
             )
         self._sessions: "OrderedDict[str, SessionState]" = OrderedDict()
         self._session_last_use: Dict[str, float] = {}
@@ -384,6 +453,11 @@ class Server:
         (self._h_session_save_ms if op == "save"
          else self._h_session_load_ms).observe(ms)
 
+    def _on_prefix_io(self, op: str, ms: float, nbytes: int) -> None:
+        (self._h_prefix_save_ms if op == "save"
+         else self._h_prefix_load_ms).observe(ms)
+        self._c_prefix_bytes.inc(nbytes, labels={"op": op})
+
     def _healthz(self) -> dict:
         """/healthz payload: the health snapshot stamped with the
         documented HTTP code for its state (health.HTTP_STATUS) — the
@@ -433,6 +507,15 @@ class Server:
             self.trace.instant(kind, id=rid,
                                session=fields.get("session"),
                                slot=fields.get("slot"))
+        elif kind == "prefix_hit":
+            self._c_prefix_hits.inc()
+            self.trace.instant("prefix_hit", id=rid,
+                               prefix_len=fields.get("prefix_len"),
+                               suffix=fields.get("suffix"))
+        elif kind == "prefix_miss":
+            self._c_prefix_misses.inc()
+        elif kind == "prefix_publish":
+            self._c_prefix_publishes.inc()
 
     # -- admission ------------------------------------------------------------
 
@@ -445,6 +528,12 @@ class Server:
             request = dataclasses.replace(
                 request, deadline_ms=self.cfg.deadline_ms
             )
+        # normalize the prompt to a HOST array on the submit thread: the
+        # scheduler — and the prefix cache's content hashing — must never
+        # pay a device readback for token bytes on the admission path
+        request = dataclasses.replace(
+            request, prompt=np.asarray(request.prompt, np.int32)
+        )
         pending = Pending(
             request, threading.Event(), admitted_at=self._clock()
         )
@@ -554,6 +643,19 @@ class Server:
                     self._tick_metrics()
                     self._tick_slo()
                     self._admit_from_queue(wd)
+                    if (self.prefix_store is not None
+                            and self.engine.has_pending_prefixes):
+                        # miss-path declarations: prefill + publish the
+                        # queued shared prefixes (one-time per novel
+                        # prefix, outside the admission path). Beat the
+                        # watchdog first — the publish is a solo prefill
+                        # plus possibly a first-time bucket compile, the
+                        # same cost class the admission beat covers; a
+                        # healthy replica must not read as stalled for
+                        # caching a prefix.
+                        if wd is not None:
+                            wd.beat("prefix publish")
+                        self.engine.publish_pending_prefixes()
                     if not self.engine.busy:
                         if (draining or drain_when_idle) and self._q.empty():
                             break

@@ -82,7 +82,17 @@ class DecodeRequest:
     history (tokens are appended to the context before generation
     continues). A continuation's ``sample`` must match the session's and
     its ``seed`` is ignored in favor of the session's (both anchor the
-    resumed rng walk)."""
+    resumed rng walk).
+
+    ``prefix_len`` declares the first ``prefix_len`` prompt tokens as a
+    SHARED, cacheable prefix (a system prompt): with a prefix store
+    configured (serving/prefix_store.py), a miss PUBLISHES the aligned
+    prefix's O(1) decode-state snapshot so later requests — on any
+    replica sharing the store — admit at O(suffix) instead of O(prompt).
+    Lookups are content-addressed and run for every request regardless;
+    the declaration only gates publishing (the server cannot guess where
+    a shared prefix ends — an undeclared publish would bake one user's
+    tokens into the cache key). 0 = no declaration."""
 
     prompt: Any
     max_new_tokens: int
@@ -90,6 +100,7 @@ class DecodeRequest:
     seed: int = 0
     deadline_ms: float = 0.0
     session_id: Optional[str] = None
+    prefix_len: int = 0
 
 
 @dataclasses.dataclass

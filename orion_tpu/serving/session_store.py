@@ -68,6 +68,14 @@ class SessionIntegrityError(RuntimeError):
     keeps serving everyone else."""
 
 
+class SessionIdentityError(SessionIntegrityError):
+    """The session was suspended under a different weights identity
+    (params id + qmode) than this server runs. NOT a fallback case —
+    older generations share the identity, and resuming cross-checkpoint
+    or cross-qmode state would silently diverge — so the mismatch
+    surfaces directly as that request's error."""
+
+
 @dataclasses.dataclass
 class SessionState:
     """One suspended conversation: the slot's device carry row (pulled to
@@ -179,9 +187,18 @@ class SessionStore:
         should_abort: Optional[Callable[[], bool]] = None,
         observer: Optional[Callable[[str, float], None]] = None,
         clock: Callable[[], float] = time.monotonic,
+        identity: Optional[str] = None,
     ):
         assert keep >= 1, keep
         self.directory = os.path.abspath(directory)
+        # ``identity``: the serving weights' provenance (params id +
+        # qmode, stamped by the Server). A suspended state row is a
+        # function of the weights it was computed under — resuming it
+        # under different weights or a different quantization mode would
+        # SILENTLY diverge (same shapes, wrong numbers), so a mismatch
+        # on load is an integrity failure, not a fallback. None (and
+        # pre-identity generations on disk) skip the check.
+        self.identity = identity
         self.keep = int(keep)
         self._retry = retry if retry is not None else RetryPolicy()
         self._should_abort = should_abort
@@ -275,6 +292,7 @@ class SessionStore:
         doc = {
             "format": SESSION_FORMAT_VERSION,
             "session_id": state.session_id,
+            "identity": self.identity,
             "generation": gen,
             "seed": int(state.seed),
             "served": int(state.served),
@@ -340,6 +358,8 @@ class SessionStore:
         for gen in reversed(gens):
             try:
                 state = self._load_gen(session_id, gen)
+            except SessionIdentityError:
+                raise  # mismatched weights: no older generation can help
             except Exception as e:  # damaged payloads surface as many types
                 failures.append((gen, e))
                 warnings.warn(
@@ -378,6 +398,16 @@ class SessionStore:
             describe=f"session load ({session_id} gen {gen})",
             should_abort=self._should_abort,
         )
+        saved_id = doc.get("identity")
+        if (self.identity is not None and saved_id is not None
+                and saved_id != self.identity):
+            raise SessionIdentityError(
+                f"session {session_id} gen {gen} was suspended under "
+                f"weights identity {saved_id!r} but this server runs "
+                f"{self.identity!r}: resuming cross-checkpoint or "
+                "cross-qmode state would silently diverge (same shapes, "
+                "wrong numbers) — refuse loudly instead"
+            )
         manifest = doc["manifest"]
         leaves: List[np.ndarray] = []
         for entry in manifest["leaves"]:
@@ -425,4 +455,7 @@ class SessionStore:
             pass
 
 
-__all__ = ["SessionStore", "SessionState", "SessionIntegrityError"]
+__all__ = [
+    "SessionStore", "SessionState", "SessionIntegrityError",
+    "SessionIdentityError",
+]

@@ -810,6 +810,63 @@ def _admit_probe(engine):
     )
 
 
+def test_decode_host_sync_prefix_paths_are_admission_scope():
+    """ISSUE 11: the prefix cache's lookup/stage/publish paths in the
+    engine are admission code — hash + disk + one fused jitted dispatch
+    only. A host sync inside a *prefix*-named function of
+    serving/batching.py is a finding even outside a loop; the store-side
+    serialization (prefix_store.py) is out of this rule's scope."""
+    synced = """
+import numpy as np
+
+def _prefix_lookup(engine, request):
+    key = engine.store.key_for(np.asarray(request.prompt))
+    return engine.store.get(key)
+
+def publish_pending_prefixes(engine):
+    for key, row in engine.pending:
+        state = engine.prefill(row)
+        engine.store.put(key, np.asarray(state))
+"""
+    found = rule_ids(
+        lint_source(synced, path="orion_tpu/serving/batching.py")
+    )
+    assert "decode-host-sync" in found
+    # the clean shape: hashing and disk checks stay in the store, the
+    # snapshot copy is one jitted row write, serialization is delegated
+    clean = """
+import jax.numpy as jnp
+
+def _prefix_lookup(engine, request):
+    return engine.store.lookup(request.prompt)  # hash + disk inside
+
+def _stage_prefix(engine, prompt, entry, i):
+    row = jnp.pad(prompt, ((0, 0), (0, engine.width - prompt.shape[1])))
+    engine.stage_row(entry.state, row, i)  # one fused dispatch
+
+def publish_pending_prefixes(engine):
+    while engine.pending:
+        key, row = engine.pending.pop(0)
+        carry = engine.prefill(row)       # jitted dispatch, no readback
+        engine.store.publish(row, carry[1])  # store owns the device_get
+"""
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(clean, path="orion_tpu/serving/batching.py")
+    )
+    # prefix-named helpers OUTSIDE the engine module keep loop scope
+    # only: the store's publish-side serialization syncs (no loop) are
+    # legal there by design
+    store_side = """
+import numpy as np
+
+def publish_prefix(store, tokens, state):
+    return store.write(np.asarray(state))  # the sanctioned device_get
+"""
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(store_side, path="orion_tpu/serving/prefix_store.py")
+    )
+
+
 def test_loop_accum_only_fires_on_hot_paths():
     src = """
 import jax.numpy as jnp
@@ -1304,6 +1361,34 @@ def test_update_golden_round_trips(tmp_path, fresh_snapshots):
     assert snapshots.audit_golden(
         golden_dir=str(tmp_path), fresh=fresh_snapshots
     ) == []
+
+
+def test_quant_decode_goldens_pin_the_serving_contract(fresh_snapshots):
+    """ISSUE 11: the int8/int4 batched-decode artifacts pin (a) ZERO
+    collectives (quantized decode still never communicates), (b) scan
+    carry bytes EXACTLY equal to the fp32 target's — only weights
+    quantize; the O(1) state must not grow or shrink with qmode — and
+    (c) real s8 traffic in the compiled program (the dequant feeds the
+    same dots the fp32 path runs), which the fp32 target must NOT show."""
+    fp32 = fresh_snapshots["decode_batched_tiny"]
+    for name in ("decode_batched_int8", "decode_batched_int4"):
+        snap = fresh_snapshots[name]
+        assert all(v == 0 for v in snap["hlo_collectives"].values()), name
+        assert snap["scan_carry_bytes"] == fp32["scan_carry_bytes"], (
+            name, "the decode carry must be qmode-invariant"
+        )
+        assert snap["dtype_counts"].get("s8", 0) > 0, (
+            name, "no int8 buffers in a quantized program?"
+        )
+        assert snap["op_histogram"].get("dot", 0) > 0, name
+    assert fp32["dtype_counts"].get("s8", 0) == 0, (
+        "the fp32 decode program must not stream int8"
+    )
+    # the int4 program carries the split-nibble signature: off-TPU the
+    # packed kernel lowers to the even/odd half-dot pair (quant.py), so
+    # its dot count strictly exceeds int8's single-dot-per-matmul form
+    assert (fresh_snapshots["decode_batched_int4"]["op_histogram"]["dot"]
+            > fresh_snapshots["decode_batched_int8"]["op_histogram"]["dot"])
 
 
 def test_donated_arg_aliasing_recorded_and_checked(fresh_snapshots):

@@ -515,7 +515,7 @@ def _proc_ref(spec, prompt, n_new, sample, seed):
     """In-parent reference over the SAME model a child builds."""
     from orion_tpu.fleet.replica import build_model
 
-    model, params = build_model(spec)
+    model, params, _ = build_model(spec)
     return np.asarray(
         generate(model, params, prompt, n_new, sample,
                  rng=jax.random.PRNGKey(seed))

@@ -174,6 +174,78 @@ def test_quantize_int4_pack_roundtrip():
     assert np.all(np.abs(np.asarray(w2 - w)) <= np.asarray(s) / 2 + 1e-9)
 
 
+@pytest.mark.parametrize("d_in,d_out", [(2, 1), (2, 3), (4, 5), (6, 7)])
+def test_unpack_nibbles_roundtrip_edge_widths(d_in, d_out):
+    """_unpack_nibbles at edge widths (ISSUE 11 hardening): the smallest
+    packable input dim, odd OUTPUT widths, and non-multiple-of-anything
+    shapes all round-trip pack -> unpack exactly."""
+    from orion_tpu.quant import _unpack_nibbles, quantize_int4_packed
+
+    # pack -> unpack is the identity on the nibble lattice: build the
+    # packed buffer exactly as quantize_int4_packed does and demand the
+    # unpack reproduces every signed nibble, even/odd rows alike
+    q = jax.random.randint(
+        jax.random.PRNGKey(3), (d_in, d_out), -7, 8
+    ).astype(jnp.int8)
+    qe, qo = q[0::2], q[1::2]
+    p = ((qe & 0x0F) | (qo << 4)).astype(jnp.int8)
+    got = _unpack_nibbles(p, d_in)
+    assert got.shape == (d_in, d_out)
+    assert np.array_equal(np.asarray(got), np.asarray(q))
+    # and the full quantize path respects the per-channel rounding bound
+    # at these widths too
+    w = q.astype(jnp.float32) * jnp.linspace(0.3, 1.7, d_out)
+    p2, s = quantize_int4_packed(w)
+    assert p2.shape == (d_in // 2, d_out) and s.shape == (d_out,)
+    w2 = np.asarray(_unpack_nibbles(p2, d_in).astype(jnp.float32) * s)
+    # s/2 + epsilon: w/s can land exactly on a .5 rounding boundary, so
+    # float32 evaluation of the bound needs a few ulps of slack
+    assert np.all(np.abs(w2 - np.asarray(w)) <= np.asarray(s) / 2 + 1e-6)
+
+
+def test_quantize_int4_packed_rejects_bad_shapes():
+    """Odd input dims, non-2D kernels, and foreign reduce axes fail with
+    a clean ValueError instead of a silent mis-shape (the packed buffer
+    would otherwise dot half its rows against the wrong nibble)."""
+    from orion_tpu.quant import quantize_int4_packed
+
+    with pytest.raises(ValueError, match="even input dim"):
+        quantize_int4_packed(jnp.ones((63, 32)))
+    with pytest.raises(ValueError, match="2-D"):
+        quantize_int4_packed(jnp.ones((4, 8, 16)))
+    with pytest.raises(ValueError, match="reduce_axes"):
+        quantize_int4_packed(jnp.ones((64, 32)), reduce_axes=(1,))
+
+
+def test_q4_matmul_rejects_bad_shapes():
+    """q4_matmul validates its operand geometry up front: odd d, a packed
+    buffer that doesn't match x's width, a mis-sized scale, and a
+    non-128-multiple block_out are all clean ValueErrors."""
+    from orion_tpu.quant import q4_matmul, quantize_int4_packed
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+    p, s = quantize_int4_packed(w)
+    x = jnp.ones((4, 64))
+    with pytest.raises(ValueError, match="even contraction"):
+        q4_matmul(jnp.ones((4, 63)), p, s, interpret=True)
+    with pytest.raises(ValueError, match="does not match"):
+        q4_matmul(jnp.ones((4, 62)), p, s, interpret=True)
+    with pytest.raises(ValueError, match="scale shape"):
+        q4_matmul(x, p, s[:-1], interpret=True)
+    with pytest.raises(ValueError, match="block_out"):
+        q4_matmul(x, p, s, block_out=100, interpret=True)
+    with pytest.raises(ValueError, match="x \\[B, d\\]"):
+        q4_matmul(jnp.ones((64,)), p, s, interpret=True)
+
+
+def test_int4_dense_rejects_odd_input_dim():
+    from orion_tpu.quant import Int4Dense
+
+    m = Int4Dense(8, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="even input dim"):
+        m.init(jax.random.PRNGKey(0), jnp.ones((2, 33)))
+
+
 def test_int4_dense_matches_manual_dequant():
     from orion_tpu.quant import Int4Dense, _unpack_nibbles, quantize_int4_packed
 
