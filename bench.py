@@ -730,6 +730,230 @@ def bench_serve_qmode(model=None, params=None, slots: int = 8,
     return out
 
 
+def bench_serve_spec(slots: int = 8, chunk: int = 4, max_new: int = 160,
+                     reps: int = 3, depths=(0, 2, 4)) -> dict:
+    """Self-speculative decode row (ISSUE 13): ms/tok on a HYBRID config
+    at spec-depth {0, 2, 4} with acceptance rates, on two weight
+    variants of the same hybrid layout (8 layers, hybrid_pattern period
+    4 — 2 global-linear, 6 swa):
+
+    - ``oracle`` — the swa blocks' output projections (attn.wo, mlp.down)
+      are ZEROED, making every swa block an exact identity: the linear
+      trunk IS the full model, so the draft's tokens equal the verify's
+      BITWISE and acceptance is exactly 1.0 by construction. This is a
+      disclosed CALIBRATION (the fleet bench's cpu-ceiling idiom): it
+      isolates the mechanism's ceiling — what a checkpoint whose linear
+      trunk carries the prediction (the paper's trained hybrid;
+      LayerSkip-style drafts) would buy — from draft quality.
+    - ``random`` — plain random init: the swa residuals the draft skips
+      are load-bearing noise, acceptance is near zero, and the row shows
+      the ADAPTIVE FLOOR earning its keep: with ``spec_min_accept`` at
+      the production default every slot falls back to plain decode
+      within a few rounds and ms/tok lands back at the depth-0 figure
+      (the no-floor variant shows what a losing draft would cost).
+
+    Methodology = the PR 8 interleaved-round discipline on an
+    engine-level micro (every (variant, depth) cell visited once per
+    round, median across rounds), plus ONE real-Server arrival-trace
+    pass on the oracle hybrid at the best depth, gated by
+    ``obs.slo.check_snapshot`` like the shared-prefix row.
+
+    Honesty note (the PR 11 qmode precedent): the verify piece's win is
+    a WEIGHT-STREAMING effect — k tokens' projections/MLP/head per
+    weight read. This CPU box still resolves a real ratio because the
+    piece amortizes per-step dispatch and gemm efficiency, but the
+    on-chip ratio is the roofline one; and the ``random`` rows are what
+    an UNTRAINED hybrid gives — acceptance on a trained checkpoint is a
+    property of the checkpoint, reported per-deployment by the
+    ``spec_accept_rate`` histogram the obs spine exposes."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orion_tpu.generate import SampleConfig
+    from orion_tpu.models.configs import ModelConfig, hybrid_pattern
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.obs import slo as obs_slo
+    from orion_tpu.serving import DecodeRequest, SlotEngine
+
+    # d256/vocab1k: wide enough that the weight matmuls dominate a step
+    # (the regime speculation targets — at toy widths the serial
+    # attention ops hide the gemm amortization even at acceptance 1.0)
+    cfg = ModelConfig(
+        name="spec_bench_hybrid", vocab_size=1024, d_model=256, n_layers=8,
+        n_heads=4, layer_types=hybrid_pattern(8, 4), window=128,
+        max_seq_len=1024, dtype="float32", backend="xla",
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    def ablate_non_linear(p):
+        """Zero the non-draft blocks' output projections: swa blocks
+        become exact identities (x + 0), so draft == full bitwise."""
+        import copy
+
+        q = jax.tree.map(lambda x: x, p)  # fresh containers
+        blocks = q["params"]
+        for i, lt in enumerate(cfg.resolved_layer_types):
+            if lt == "linear":
+                continue
+            blk = copy.copy(blocks[f"block_{i}"])
+            blk["attn"] = dict(blk["attn"])
+            blk["mlp"] = dict(blk["mlp"])
+            blk["attn"]["wo"] = {
+                "kernel": jnp.zeros_like(blk["attn"]["wo"]["kernel"])
+            }
+            blk["mlp"]["down"] = {
+                "kernel": jnp.zeros_like(blk["mlp"]["down"]["kernel"])
+            }
+            blocks[f"block_{i}"] = blk
+        return q
+
+    variants = {"random": params, "oracle": ablate_non_linear(params)}
+    sample = SampleConfig(temperature=0.0)
+    prompt = jnp.ones((1, 8), jnp.int32)
+
+    def one_micro(p, depth, min_accept, n_boundaries=24):
+        """One engine-level pass: ms/tok over ``n_boundaries`` engine
+        boundaries with all slots resident (tokens counted from the
+        host mirrors — variable per boundary when speculating)."""
+        eng = SlotEngine(model, params=p, slots=slots, chunk=chunk,
+                         spec_depth=depth, spec_min_accept=min_accept)
+        for s in range(slots):
+            eng.admit(DecodeRequest(
+                prompt=prompt, max_new_tokens=cfg.max_seq_len - 16,
+                sample=sample, seed=s,
+            ), tag=s)
+        eng.step()  # warm: compiles stay out of the timed window
+        base = sum(s.n_emitted for s in eng._slots if s is not None)
+        t0 = time.perf_counter()
+        for _ in range(n_boundaries):
+            eng.step()
+        elapsed = time.perf_counter() - t0
+        toks = sum(
+            s.n_emitted for s in eng._slots if s is not None
+        ) - base
+        acc = sum(s.spec_accepted for s in eng._slots if s is not None)
+        drafted = sum(s.spec_drafted for s in eng._slots if s is not None)
+        floored = int(np.sum(~eng._spec_on_np[:eng.active_count]))
+        return {
+            "ms_per_tok": elapsed / max(toks, 1) * 1e3,
+            "accept_rate": acc / drafted if drafted else None,
+            "floored_slots": floored,
+        }
+
+    # cells: (variant, depth, floor); the floor cell shows the adaptive
+    # fallback recovering the losing random draft
+    cells = [(v, d, 0.0) for v in variants for d in depths]
+    cells.append(("random", max(depths), 0.2))
+    acc_cells = {c: [] for c in cells}
+    for c in cells:  # warm every cell's compiles before any timing
+        one_micro(variants[c[0]], c[1], c[2], n_boundaries=2)
+    for rep in range(max(reps, 3)):
+        order = cells[rep % len(cells):] + cells[:rep % len(cells)]
+        for c in order:
+            acc_cells[c].append(one_micro(variants[c[0]], c[1], c[2]))
+    rows = {}
+    for (v, d, fl), runs in acc_cells.items():
+        key = f"{v}_depth{d}" + ("_floor" if fl else "")
+        accs = [r["accept_rate"] for r in runs if r["accept_rate"]
+                is not None]
+        rows[key] = {
+            "ms_per_tok": round(
+                statistics.median(r["ms_per_tok"] for r in runs), 5
+            ),
+            "accept_rate": round(statistics.median(accs), 4) if accs
+            else None,
+            "floored_slots": runs[-1]["floored_slots"],
+        }
+    for v in variants:
+        base = rows[f"{v}_depth0"]["ms_per_tok"]
+        for d in depths:
+            rows[f"{v}_depth{d}"]["vs_depth0"] = round(
+                rows[f"{v}_depth{d}"]["ms_per_tok"] / base, 3
+            )
+    rows[f"random_depth{max(depths)}_floor"]["vs_depth0"] = round(
+        rows[f"random_depth{max(depths)}_floor"]["ms_per_tok"]
+        / rows["random_depth0"]["ms_per_tok"], 3
+    )
+    out = {
+        "config": "hybrid 8L period-4 (2 linear, 6 swa), d256, "
+                  "vocab 1k, window 128, fp32",
+        "slots": slots, "chunk": chunk,
+        "depths": list(depths), "reps_median_of": max(reps, 3),
+        "interleaved_rounds": True, "rows": rows,
+    }
+    # real-Server arrival-trace passes at the oracle's best depth vs
+    # depth 0 — INTERLEAVED rounds like every other cell (a sequential
+    # pair measures whatever the box was doing that minute), scored by
+    # medians; SLO-gated below so a shedding pass cannot land
+    best = max(d for d in depths if d > 0)
+    arrivals = _serve_trace(16, 500.0)
+    for d in (0, best):  # warm both programs outside the timed rounds
+        _serve_one_trace(
+            model, variants["oracle"], slots, chunk, arrivals, prompt,
+            sample, max_new, warm=True,
+            serve_kw={"spec_depth": d, "spec_min_accept": 0.0},
+        )
+    tps = {0: [], best: []}
+    for rep in range(max(reps, 3)):
+        order = (0, best) if rep % 2 == 0 else (best, 0)
+        for d in order:
+            row = _serve_one_trace(
+                model, variants["oracle"], slots, chunk, arrivals,
+                prompt, sample, max_new, warm=False,
+                serve_kw={"spec_depth": d, "spec_min_accept": 0.0},
+            )
+            tps[d].append(row["tokens_per_sec"])
+            out[f"trace_oracle_depth{d}"] = row
+    for d in (0, best):
+        out[f"trace_oracle_depth{d}"]["tokens_per_sec"] = round(
+            statistics.median(tps[d]), 2
+        )
+        out[f"trace_oracle_depth{d}"]["tokens_per_sec_reps"] = [
+            round(x, 2) for x in tps[d]
+        ]
+    out["trace_speedup"] = round(
+        statistics.median(tps[best]) / max(statistics.median(tps[0]),
+                                           1e-9), 3
+    )
+    # gate on a snapshot taken from a dedicated gated pass
+    from orion_tpu.serving import ServeConfig, Server
+
+    srv = Server(model, variants["oracle"],
+                 ServeConfig(chunk=chunk, slots=slots, max_inflight=16,
+                             spec_depth=best, spec_min_accept=0.0))
+    ps = [srv.submit(DecodeRequest(prompt=prompt, max_new_tokens=32,
+                                   sample=sample, seed=i))
+          for i in range(8)]
+    srv.serve(drain_when_idle=True)
+    snap = srv.snapshot()["metrics"]
+    srv.close()
+    assert all(p.result is not None and p.result.status == "ok"
+               for p in ps)
+    rows_chk, ok = obs_slo.check_snapshot(
+        [obs_slo.Objective(name="error_rate", kind="error_rate",
+                           target=0.99),
+         obs_slo.Objective(name="availability", kind="availability",
+                           target=0.99)],
+        snap,
+    )
+    out["slo_check"] = "ok" if ok else "VIOLATED"
+    if not ok:
+        out["slo_check_rows"] = rows_chk
+    out["onchip_note"] = (
+        "the verify piece's win is weight-streaming (k tokens per "
+        "weight read): this box's CPU ratio reflects dispatch+gemm "
+        "amortization; the TPU lowering realizes the roofline ratio. "
+        "The oracle rows are the mechanism's ceiling (acceptance 1.0 "
+        "by construction, disclosed); untrained-hybrid acceptance is "
+        "near zero and the adaptive floor recovers plain-decode cost."
+    )
+    return out
+
+
 def _prefix_trace_pass(model, params, prefix, suffixes, max_new, slots,
                        chunk, prefill_chunk, prefix_dir, declare) -> dict:
     """One pass of the shared-prefix arrival trace: every request is
@@ -1856,6 +2080,12 @@ def main(argv=None) -> int:
                          "qmode off/int8/int4 (interleaved rounds); "
                          "updates the 'qmode' row of BENCH_SERVE.json in "
                          "place (the full --serve run includes it too)")
+    ap.add_argument("--serve-spec", action="store_true",
+                    help="self-speculative serving row: ms/tok on a "
+                         "hybrid config at spec-depth {0,2,4} with "
+                         "acceptance rates (oracle-draft calibration + "
+                         "random-weight floor behaviour), committed to "
+                         "BENCH_SERVE.json 'speculative'")
     ap.add_argument("--shared-prefix", action="store_true",
                     help="prefix-cache bench only: 64 requests sharing a "
                          "1k-token system prompt, cold vs warm store + "
@@ -1920,6 +2150,20 @@ def main(argv=None) -> int:
                 m: res["rows"][m].get("ms_per_tok_vs_off")
                 for m in ("int8", "int4")
             },
+        }))
+        return 0
+
+    if args.serve_spec:
+        res = bench_serve_spec()
+        _update_bench_serve_row("speculative", res)
+        print(json.dumps({
+            "metric": "serve_spec_hybrid",
+            "ms_per_tok": {k: v["ms_per_tok"]
+                           for k, v in res["rows"].items()},
+            "accept_rate": {k: v["accept_rate"]
+                            for k, v in res["rows"].items()},
+            "trace_speedup": res.get("trace_speedup"),
+            "slo_check": res.get("slo_check"),
         }))
         return 0
 

@@ -40,6 +40,15 @@ function of ``serving/batching.py`` is the same finding. The store-side
 serialization (publish's device_get) lives in
 ``serving/prefix_store.py`` by design, off the engine's hot path.
 
+ISSUE 13 covers the SPECULATION path the same way: any host sync inside
+a ``draft``/``verify``/``spec``-named function of ``serving/batching.py``
+is a finding — the accept/reject decision must come from the existing
+single per-chunk probe transfer (the accepted counts ride the same
+stacked readback as the finite/done flags), never a second readback per
+round; a draft pass or verify piece that syncs the host mid-boundary
+re-creates exactly the lockstep ping-pong the batched round exists to
+avoid. Probe-named functions remain the designated sync point.
+
 Scope: the decode modules only (``orion_tpu/serving/`` and
 ``generate.py``); host loops elsewhere (eval CLIs, data prep) may sync
 freely. Traced code is already covered by ``tracer-host``; this rule is
@@ -66,19 +75,31 @@ def _is_decode_module(path: str) -> bool:
 
 
 _ADMIT_MARKERS = ("admit", "insert", "stage", "prefix")
+_SPEC_MARKERS = ("draft", "verify", "spec")
+
+
+def _inside_marked(node: ast.AST, markers) -> bool:
+    """Lexically inside a function whose name carries one of ``markers``."""
+    cur = getattr(node, "_orion_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+            m in cur.name for m in markers
+        ):
+            return True
+        cur = getattr(cur, "_orion_parent", None)
+    return False
 
 
 def _inside_admission(node: ast.AST) -> bool:
     """Lexically inside an admission-path function of the engine (see
     module docstring: names containing admit/insert/stage/prefix)."""
-    cur = getattr(node, "_orion_parent", None)
-    while cur is not None:
-        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
-            m in cur.name for m in _ADMIT_MARKERS
-        ):
-            return True
-        cur = getattr(cur, "_orion_parent", None)
-    return False
+    return _inside_marked(node, _ADMIT_MARKERS)
+
+
+def _inside_spec(node: ast.AST) -> bool:
+    """Lexically inside a speculation-path function of the engine (see
+    module docstring: names containing draft/verify/spec)."""
+    return _inside_marked(node, _SPEC_MARKERS)
 
 
 def _inside_probe(node: ast.AST) -> bool:
@@ -164,21 +185,33 @@ class DecodeHostSyncRule:
                 if not isinstance(node, ast.Call) or id(node) in seen:
                     continue
                 sync = _sync_label(node)
-                if sync is None or not _inside_admission(node):
+                if sync is None or _inside_probe(node):
                     continue
-                if _inside_probe(node):
-                    continue
-                seen.add(id(node))
-                yield Finding(
-                    self.id, ctx.path, node.lineno,
-                    f"{sync} on the admission path (a function named "
-                    "*admit*/*insert*/*stage*/*prefix*): admission is an "
-                    "O(1) slot insert — stage the prompt (or the cached "
-                    "prefix row) into the carry and let the unified scan "
-                    "consume it; a per-admit host sync re-creates the "
-                    "head-of-line stall (prefix-store serialization "
-                    "belongs in serving/prefix_store.py)",
-                )
+                if _inside_admission(node):
+                    seen.add(id(node))
+                    yield Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"{sync} on the admission path (a function named "
+                        "*admit*/*insert*/*stage*/*prefix*): admission is "
+                        "an O(1) slot insert — stage the prompt (or the "
+                        "cached prefix row) into the carry and let the "
+                        "unified scan consume it; a per-admit host sync "
+                        "re-creates the head-of-line stall (prefix-store "
+                        "serialization belongs in serving/prefix_store.py)",
+                    )
+                elif _inside_spec(node):
+                    seen.add(id(node))
+                    yield Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"{sync} on the speculation path (a function "
+                        "named *draft*/*verify*/*spec*): the accept/"
+                        "reject decision must ride the existing single "
+                        "per-chunk probe transfer (the accepted counts "
+                        "stack with the finite/done flags) — a second "
+                        "readback per speculative round re-creates the "
+                        "lockstep host-device ping-pong the batched "
+                        "round exists to avoid",
+                    )
         # the probe budget: ONE probe sync per chunk loop, slot count
         # notwithstanding (the continuous-batching scheduler contract)
         for loop, calls in probes_per_loop.values():

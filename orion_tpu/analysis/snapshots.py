@@ -316,6 +316,52 @@ def _snap_decode_batched_quant(mode: str) -> Tuple[Any, Any, Dict[str, Any]]:
     return jaxpr, lowered, meta
 
 
+def _snap_decode_batched_spec_tiny() -> Tuple[Any, Any, Dict[str, Any]]:
+    """The self-speculative round (ISSUE 13,
+    generate.decode_batched_spec_round) at slots=8, spec depth=4 on the
+    tiny config — the artifact that pins the draft-verify program's
+    shape: collectives stay ZERO (speculation never communicates), and
+    the largest scan carry must NOT exceed the plain batched decode's —
+    the draft scan threads the SAME (S, z) rows (shadow copies of the
+    carry's own leaves, no growth) and the verify's inner scans carry
+    one layer's state at a time. tests/test_analysis.py asserts the
+    no-growth bound against ``decode_batched_tiny`` and
+    tests/test_spec_decode.py the slot-linearity of the carry."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import SampleConfig, _decode_batched_spec_round_jit
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM, init_decode_state
+
+    cfg = get_config("tiny")
+    model = TransformerLM(cfg)
+    slots, depth = 8, 4
+    key = jax.random.PRNGKey(0)
+    prompt = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    params = jax.eval_shape(model.init, key, prompt)
+    states = jax.eval_shape(partial(init_decode_state, cfg, slots))
+    vec = lambda dt: jax.ShapeDtypeStruct((slots,), dt)  # noqa: E731
+    carry = (
+        vec(jnp.int32), states, vec(jnp.int32), vec(jnp.int32),
+        vec(jnp.bool_),
+    )
+    rngs = jax.ShapeDtypeStruct((slots, 2), jnp.uint32)
+    active = vec(jnp.bool_)
+    spec_on = vec(jnp.bool_)
+    args = (
+        model, params, carry, rngs, active, spec_on, depth, SampleConfig(),
+    )
+    jaxpr = jax.make_jaxpr(
+        _decode_batched_spec_round_jit, static_argnums=(0, 6, 7)
+    )(*args)
+    lowered = _decode_batched_spec_round_jit.lower(*args)
+    meta = {"slots": slots, "spec_depth": depth, "donated_args": 0}
+    return jaxpr, lowered, meta
+
+
 def _snap_decode_batched_int8():
     return _snap_decode_batched_quant("int8")
 
@@ -331,6 +377,7 @@ SNAPSHOT_TARGETS: Dict[str, Callable[[], Tuple[Any, Any, Dict[str, Any]]]] = {
     "decode_tiny": _snap_decode_tiny,
     "decode_batched_tiny": _snap_decode_batched_tiny,
     "decode_batched_prefill_tiny": _snap_decode_batched_prefill_tiny,
+    "decode_batched_spec_tiny": _snap_decode_batched_spec_tiny,
     "decode_batched_int8": _snap_decode_batched_int8,
     "decode_batched_int4": _snap_decode_batched_int4,
 }

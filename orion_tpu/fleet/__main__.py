@@ -67,6 +67,16 @@ def build_argparser() -> argparse.ArgumentParser:
                         "replica (each child quantizes the same params "
                         "the same deterministic way, so placement stays "
                         "invisible in the tokens)")
+    p.add_argument("--spec-depth", type=int, default=0,
+                   help="self-speculative decode inside EVERY replica: "
+                        "the global-linear layers draft, one batched "
+                        "piece verifies — tokens stay BITWISE identical "
+                        "to plain decode, so placement AND speculation "
+                        "are both invisible in the output (0 = off)")
+    p.add_argument("--spec-min-accept", type=float, default=0.2,
+                   help="per-slot adaptive speculation floor inside each "
+                        "replica (rolling acceptance below this falls "
+                        "back to plain decode; 0 = never)")
     p.add_argument("--prefix-dir", default=None,
                    help="SHARED content-addressed prefix cache: a system "
                         "prompt published by one replica admits O(suffix) "
@@ -147,6 +157,8 @@ def _spec_from_args(args) -> ReplicaSpec:
         "grace": args.grace,
         "session_dir": args.session_dir,
         "qmode": args.qmode,
+        "spec_depth": args.spec_depth,
+        "spec_min_accept": args.spec_min_accept,
         "prefix_dir": args.prefix_dir,
         # params_id is NOT set here: every replica derives it from the
         # weights it actually loads (build_model — config + overrides +

@@ -561,6 +561,151 @@ def decode_batched_prefill_chunk(
     )
 
 
+# -- self-speculative decode (ISSUE 13) ---------------------------------------
+# The hybrid config contains its own draft model for free: the global-
+# linear layers are pure O(1) recurrence, so they can run ahead k tokens
+# (transformer.draft_step — embed -> linear blocks only -> head, shadow
+# (S, z), no cache touched) at a fraction of the full forward's cost.
+# The full model then verifies ALL k drafts in ONE batched piece
+# (transformer.verify_step): every weight matmul runs once as a k-row
+# gemm — the speculative win on weight-bandwidth-bound hardware — while
+# the state recurrence replays decode_step's exact per-token op sequence,
+# so the verify logits are BITWISE the plain decode walk's logits.
+# Verification is token-matching against the full model's samples at the
+# SAME rng folds the plain walk uses (the draft samples with the same
+# folds too — shared randomness maximizes matches in sampled mode): the
+# emitted tokens are therefore ALWAYS the plain walk's tokens, greedy
+# and sampled alike — the draft can only change speed, never output —
+# which is strictly stronger than the distribution-identity classical
+# leftover-rejection speculation offers. Rejected drafts never touch the
+# carry: the clamped advance (transformer.advance_verified_states)
+# re-applies exactly the accepted prefix's updates.
+
+
+def _spec_round_body(
+    model, params, sample_cfg: SampleConfig, rngs, active, spec_on,
+    depth: int, carry,
+):
+    """One speculative round over the slot-multiplexed carry: draft up
+    to ``depth`` tokens per slot, verify them all in one batched piece,
+    advance each slot by its accepted prefix + 1. Returns
+    (new_carry, emitted [S, depth+1], accepted [S]).
+
+    Per-slot: the round consumes ``keep = accepted + 1`` fed tokens
+    (the pending token always verifies — its logits consumed only real
+    context) and emits ``keep`` values with the plain body's EOS/PAD
+    semantics; the new pending token is the full model's sample at fold
+    ``emit + keep`` — exactly the invariant the plain body maintains, so
+    speculative and plain boundaries interleave bitwise-transparently
+    (mid-prefill boundaries ride the unified program, non-speculating
+    slots ride with ``spec_on`` False and advance one token per round)."""
+    from orion_tpu.models.transformer import linear_layer_indices
+
+    token, states, t, emit, done = carry
+    k = depth
+    lin = linear_layer_indices(model.cfg)
+    lin_states = [states[i] for i in lin]
+
+    # 1) draft: k cheap linear-trunk steps; the shadow (S, z) dies here
+    def draft_body(c, _):
+        tok, lst, tt, em = c
+        lg, lst = model.apply(params, tok, lst, tt, method="draft_step")
+        keys = jax.vmap(jax.random.fold_in)(rngs, em + 1)
+        nxt = _sample_rows(lg, keys, sample_cfg)
+        return (nxt, lst, tt + 1, em + 1), nxt
+
+    if k:
+        _, drafts = jax.lax.scan(
+            draft_body, (token, lin_states, t, emit), None, length=k
+        )
+        drafts = jnp.moveaxis(drafts, 0, 1)  # [S, k]
+    else:
+        drafts = jnp.zeros((token.shape[0], 0), token.dtype)
+    fed = jnp.concatenate([token[:, None], drafts], axis=1)  # [S, k+1]
+
+    # 2) verify: full-model logits at every fed position, one piece
+    logits, upds = model.apply(params, fed, states, t, method="verify_step")
+
+    # 3) re-sample at the exact folds the plain walk burns
+    def samp_body(em, lg_j):
+        keys = jax.vmap(jax.random.fold_in)(rngs, em + 1)
+        return em + 1, _sample_rows(lg_j, keys, sample_cfg)
+
+    _, cs = jax.lax.scan(samp_body, emit, jnp.moveaxis(logits, 1, 0))
+    cs = jnp.moveaxis(cs, 0, 1)  # [S, k+1]; cs[:, j] is the fold-emit+1+j draw
+
+    # 4) accepted prefix: token-match, clamped for non-speculating rows
+    if k:
+        match = (drafts == cs[:, :k]).astype(jnp.int32)
+        n = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+    else:
+        n = jnp.zeros(token.shape, jnp.int32)
+    n = jnp.where(spec_on & active, n, 0)
+    keep = jnp.where(active, n + 1, 0)  # fed tokens consumed per row
+
+    # 5) emitted values, replaying the plain body's done/EOS walk
+    if sample_cfg.eos_token >= 0:
+        def emit_body(dn, j):
+            live = active & (j < keep)
+            e = jnp.where(dn | ~live, sample_cfg.pad_token, fed[:, j])
+            dn = dn | (live & (e == sample_cfg.eos_token))
+            return dn, e
+
+        done2, es = jax.lax.scan(emit_body, done, jnp.arange(k + 1))
+        emitted = jnp.moveaxis(es, 0, 1)
+    else:
+        live = active[:, None] & (jnp.arange(k + 1)[None, :] < keep[:, None])
+        emitted = jnp.where(live, fed, sample_cfg.pad_token)
+        done2 = done
+
+    # 6) clamped advance: exactly the accepted prefix's updates land
+    states = model.apply(
+        params, states, upds, t, keep, method="advance_verified_states"
+    )
+
+    # 7) the new pending token: the full model's fold-(emit+keep) sample
+    nxt = jnp.take_along_axis(cs, n[:, None], axis=1)[:, 0]
+    token = jnp.where(active, nxt, token)
+    return (token, states, t + keep, emit + keep, done2), emitted, n
+
+
+@partial(jax.jit, static_argnums=(0, 6, 7))
+def _decode_batched_spec_round_jit(
+    model: TransformerLM,
+    params: Any,
+    carry: Any,
+    rngs: Array,
+    active: Array,
+    spec_on: Array,
+    depth: int,
+    sample_cfg: SampleConfig,
+) -> Tuple[Any, Array, Array]:
+    return _spec_round_body(
+        model, params, sample_cfg, rngs, active, spec_on, depth, carry
+    )
+
+
+def decode_batched_spec_round(
+    model: TransformerLM,
+    params: Any,
+    carry: Any,
+    rngs: Array,
+    active: Array,
+    spec_on: Array,
+    depth: int,
+    sample_cfg: SampleConfig,
+):
+    """Advance the slot-multiplexed carry by one speculative round (see
+    :func:`_spec_round_body`). Everything per-slot — positions, folds,
+    the active and per-slot speculation masks — rides traced, so the
+    engine's lifetime costs ONE compile per (slots, spec depth, qmode);
+    the plain and unified programs' compiled bytes are untouched (golden
+    ``decode_batched_tiny`` / ``decode_batched_prefill_tiny``)."""
+    return _decode_batched_spec_round_jit(
+        model, params, carry, rngs, active, spec_on, int(depth), sample_cfg
+    )
+
+
 def generate_chunked(
     model: TransformerLM,
     params: Any,

@@ -513,6 +513,140 @@ class Attention(nn.Module):
         ))
         return out, {"k": kc, "v": vc}
 
+    # -- speculative verify: batched re-walk of k decode steps ----------------
+
+    def verify_extend(
+        self, x: Array, state: State, t: Array
+    ) -> Tuple[Array, State]:
+        """Self-speculative VERIFY piece for one attention layer: ``x``
+        [B, P, D] holds the hidden rows of P candidate tokens at
+        positions ``t``..``t+P-1`` (``t`` a per-sequence [B] vector).
+        Returns (attn out for every row, the per-token state-update
+        payload for :meth:`advance_verified`).
+
+        The bitwise contract — THE one speculative decoding needs — is
+        identity with P successive :meth:`decode_step` calls, not with
+        prefill: the projections run as one P-row gemm (row-stable: each
+        output row's reduction is independent of the batch shape, pinned
+        by tests/test_spec_decode.py), while the state-dependent part —
+        the (S, z) recurrence, the cache read-modify-write — replays
+        decode_step's exact per-token op sequence at the same [B, H, Dh]
+        shapes via a P-step inner scan. That is deliberately NOT
+        :meth:`prefill_extend`'s chunk-granular gemm fold, which is
+        bitwise against monolithic PREFILL but accumulates differently
+        from the matvec decode walk (the measured 1e-6 the prefill-piece
+        docstring records). Weights still stream once for all P rows —
+        the speculative win — only the cheap recurrence stays sequential.
+
+        The returned state is a SHADOW advanced by all P tokens; callers
+        discard it (rejected drafts must never become the carry) and
+        re-apply the accepted prefix via :meth:`advance_verified`."""
+        cfg = self.cfg
+        q, k, v = self._heads(x)  # [B, H, P, Dh]
+        to_steps = lambda a: jnp.moveaxis(a, 2, 0)  # noqa: E731
+        if self.layer_type == "linear":
+            qf, kf = self._phi_map(q), self._phi_map(k)
+
+            def body(carry, qkv):
+                qj, kj, vj = qkv  # [B, H, Dh] — decode_step's shapes
+                out, carry = recurrent_step(qj, kj, vj, carry)
+                return carry, out
+
+            _, outs = jax.lax.scan(
+                body, (state["s"], state["z"]),
+                (to_steps(qf), to_steps(kf), to_steps(v)),
+            )
+            out = jnp.moveaxis(outs, 0, 2)  # [B, H, P, Dh]
+            upd = {"k": kf, "v": v}
+        else:
+            cap = state["k"].shape[-2]
+            b_idx = jnp.arange(x.shape[0])
+
+            def body(carry, qkv):
+                kc, vc, tj = carry
+                qj, kj, vj = qkv
+                # the decode_step per-seq path, one token at a time
+                qr = apply_rotary_at(qj, self.freqs, tj[:, None])
+                kr = apply_rotary_at(kj, self.freqs, tj[:, None])
+                slot = tj % cap if self.layer_type == "swa" else tj
+                kc = kc.at[b_idx, :, slot, :].set(kr.astype(kc.dtype))
+                vc = vc.at[b_idx, :, slot, :].set(vj.astype(vc.dtype))
+                valid = jnp.arange(cap)[None, None, :] <= tj[:, None, None]
+                outj = cached_attention(qr, kc, vc, valid)
+                return (kc, vc, tj + 1), (outj, kr)
+
+            _, (outs, krs) = jax.lax.scan(
+                body, (state["k"], state["v"], t),
+                (to_steps(q), to_steps(k), to_steps(v)),
+            )
+            out = jnp.moveaxis(outs, 0, 2)
+            upd = {"k": jnp.moveaxis(krs, 0, 2), "v": v}
+        return self._merge(out, single=False), upd
+
+    def advance_verified(
+        self, state: State, upd: State, t: Array, keep: Array
+    ) -> State:
+        """Clamped state advance after verification: re-apply the first
+        ``keep`` (per-sequence, traced) of the P per-token updates
+        :meth:`verify_extend` computed, leaving the rest of the state
+        BITWISE untouched — rejected drafts are never observable.
+
+        - linear — replay recurrent_step's fp32 rank-1 adds in sequence,
+          each behind a where-select on ``j < keep``: elementwise ops on
+          identical operands, so the kept prefix is bitwise the
+          sequential walk and a skipped add leaves (S, z) exactly as it
+          was.
+        - softmax/swa — one masked batched scatter: token j writes its
+          (rotary'd) row at its own slot when ``j < keep``, else writes
+          the CURRENT cache row back (a bitwise no-op). P consecutive
+          positions hit P distinct slots (the engine enforces
+          spec depth + 1 <= window), so the scatter equals the
+          sequential writes."""
+        p = upd["v"].shape[2]
+        if self.layer_type == "linear":
+            kf = upd["k"].astype(jnp.float32)
+            vf = upd["v"].astype(jnp.float32)
+            m = keep.reshape(keep.shape + (1,) * 3)
+
+            def body(carry, inp):
+                s, z = carry
+                kj, vj, j = inp
+                s2 = s + kj[..., :, None] * vj[..., None, :]
+                z2 = z + kj
+                take = j < m
+                return (
+                    jnp.where(take, s2, s),
+                    jnp.where(take[..., 0], z2, z),
+                ), None
+
+            (s, z), _ = jax.lax.scan(
+                body, (state["s"], state["z"]),
+                (jnp.moveaxis(kf, 2, 0), jnp.moveaxis(vf, 2, 0),
+                 jnp.arange(p)),
+            )
+            return {"s": s, "z": z}
+        cap = state["k"].shape[-2]
+        pos = t[:, None] + jnp.arange(p)[None, :]  # [B, P]
+        # UNclipped for softmax, exactly like decode_step's slot = t: an
+        # overshoot position past the cache capacity must DROP (jax
+        # out-of-bounds scatter semantics), not clamp-write — bitwise
+        # with the sequential walk either way
+        slot = pos % cap if self.layer_type == "swa" else pos
+        b_idx = jnp.arange(t.shape[0])[:, None]
+        m = (jnp.arange(p)[None, :] < keep[:, None])[:, :, None, None]
+        cur_k = state["k"][b_idx, :, slot, :]  # [B, P, H, Dh]
+        cur_v = state["v"][b_idx, :, slot, :]
+        new_k = jnp.where(
+            m, jnp.moveaxis(upd["k"], 2, 1).astype(state["k"].dtype), cur_k
+        )
+        new_v = jnp.where(
+            m, jnp.moveaxis(upd["v"], 2, 1).astype(state["v"].dtype), cur_v
+        )
+        return {
+            "k": state["k"].at[b_idx, :, slot, :].set(new_k),
+            "v": state["v"].at[b_idx, :, slot, :].set(new_v),
+        }
+
     # -- one-token decode ---------------------------------------------------
 
     def decode_step(self, x: Array, state: State, t: Array) -> Tuple[Array, State]:
@@ -716,6 +850,12 @@ class Block(nn.Module):
         x = x + self.mlp(self.norm2(x))
         return x, state
 
+    def verify_extend(self, x, state, t):
+        h, upd = self.attn.verify_extend(self.norm1(x), state, t)
+        x = x + h
+        x = x + self.mlp(self.norm2(x))
+        return x, upd
+
 
 class TransformerLM(nn.Module):
     """Decoder LM over token ids; see module docstring for the 3 methods."""
@@ -915,6 +1055,70 @@ class TransformerLM(nn.Module):
             new_states.append(st)
         return self._head(x), new_states
 
+    # -- self-speculative decode (ISSUE 13) -----------------------------------
+
+    def draft_step(
+        self, token: Array, lin_states: List[State], t: Array
+    ) -> Tuple[Array, List[State]]:
+        """One DRAFT step: the model's own global-linear sublayers run as
+        a cheap standalone decoder — embed -> only the ``linear`` blocks
+        of ``cfg.resolved_layer_types`` (softmax/swa blocks are skipped
+        entirely: no cache read, no cache write, no window attend) ->
+        final norm -> head. ``lin_states`` is the linear layers' (S, z)
+        sublist in layer order — the SAME O(1) carry rows the full model
+        threads, so the draft runs ahead k tokens at a fraction of the
+        full forward's cost with zero extra weights and no cache growth.
+        The caller walks a functional shadow copy and discards it after
+        verification: draft quality affects only the ACCEPTANCE RATE
+        (speed), never the emitted tokens — verification re-samples from
+        the full model's logits (see generate.decode_batched_spec_round)."""
+        x = self._embed(token, t)
+        new_states: List[State] = []
+        it = iter(lin_states)
+        for blk, lt in zip(self.blocks, self.cfg.resolved_layer_types):
+            if lt != "linear":
+                continue
+            x, st = blk.decode_step(x, next(it), t)
+            new_states.append(st)
+        return self._head(x), new_states
+
+    def verify_step(
+        self, tokens: Array, states: List[State], t: Array
+    ) -> Tuple[Array, List[List[State]]]:
+        """Speculative VERIFY: ``tokens`` [B, P] are the pending token
+        plus P-1 drafted continuations per slot, ``t`` [B] their start
+        positions. Returns (full-model logits at EVERY fed position
+        [B, P, V], the per-layer update payloads for
+        :meth:`advance_verified_states`).
+
+        Logits come out BITWISE identical to feeding the P tokens
+        through P successive :meth:`decode_step` calls (the per-layer
+        contract: Attention.verify_extend), while every weight matmul —
+        qkv/out projections, MLP, head — runs ONCE as a P-row gemm. On
+        weight-bandwidth-bound hardware that is the speculative win: one
+        weight stream verifies k tokens; only the O(1)-state recurrence
+        (elementwise, no weights) stays sequential."""
+        p = tokens.shape[-1]
+        pos = t[:, None] + jnp.arange(p)[None, :]
+        x = self._embed(tokens, pos)
+        upds: List[State] = []
+        for blk, st in zip(self.blocks, states):
+            x, upd = blk.verify_extend(x, st, t)
+            upds.append(upd)
+        return self._head(x), upds
+
+    def advance_verified_states(
+        self, states: List[State], upds: List[State], t: Array, keep: Array
+    ) -> List[State]:
+        """Apply the first ``keep`` (per-sequence) verified tokens' state
+        updates from :meth:`verify_step`'s payload onto ``states`` —
+        rows' rejected suffixes leave the state bitwise untouched (see
+        Attention.advance_verified)."""
+        return [
+            blk.attn.advance_verified(st, upd, t, keep)
+            for blk, st, upd in zip(self.blocks, states, upds)
+        ]
+
     def prefill_extend_step(
         self, tokens: Array, states: List[State], offset: Array, length: Array
     ) -> Tuple[Array, List[State]]:
@@ -940,6 +1144,15 @@ class TransformerLM(nn.Module):
             x, jnp.maximum(length - 1, 0), 1, axis=1
         )
         return self._head(last)[:, 0], new_states
+
+
+def linear_layer_indices(cfg: ModelConfig) -> Tuple[int, ...]:
+    """Indices of the global-linear layers — the model's built-in draft
+    (``TransformerLM.draft_step``); the speculative engine slices these
+    rows out of the batched state to thread the draft's (S, z) carry."""
+    return tuple(
+        i for i, lt in enumerate(cfg.resolved_layer_types) if lt == "linear"
+    )
 
 
 def snapshot_decode_state(states: List[State]) -> List[State]:
@@ -1054,5 +1267,5 @@ __all__ = [
     "TransformerLM", "Attention", "Block", "MLP", "init_decode_state",
     "snapshot_decode_state", "decode_state_finite",
     "decode_state_finite_per_slot", "insert_decode_slot",
-    "extract_decode_slot",
+    "extract_decode_slot", "linear_layer_indices",
 ]

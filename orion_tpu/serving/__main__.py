@@ -77,6 +77,21 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--stall-timeout", type=float, default=0.0,
                    help="watchdog heartbeat budget per decode chunk "
                         "(0 = off); must exceed compile + one chunk")
+    p.add_argument("--spec-depth", type=int, default=0,
+                   help="self-speculative decode: the model's own "
+                        "global-linear layers draft up to this many "
+                        "tokens per slot and the full hybrid verifies "
+                        "them in ONE batched piece — output stays "
+                        "BITWISE identical to plain decode (greedy and "
+                        "sampled), only the speed changes; 0 = off "
+                        "(dense configs with >= 1 linear layer; "
+                        "spec-depth + 1 <= window on swa configs)")
+    p.add_argument("--spec-min-accept", type=float, default=0.2,
+                   help="adaptive speculation floor: a slot whose "
+                        "rolling draft-acceptance EWMA drops below this "
+                        "falls back to plain decode for the rest of its "
+                        "residency instead of paying a losing draft "
+                        "(0 = never fall back)")
     p.add_argument("--qmode", choices=["off", "int8", "int4"],
                    default="off",
                    help="weight-streamed quantized serving: the loaded "
@@ -270,6 +285,8 @@ def _run(args, guard) -> int:
             prefill_chunk=args.prefill_chunk,
             prompt_overflow=args.prompt_overflow,
             session_dir=args.session_dir, session_idle_s=args.session_idle_s,
+            spec_depth=args.spec_depth,
+            spec_min_accept=args.spec_min_accept,
             qmode=args.qmode, prefix_dir=args.prefix_dir,
             params_id=params_id,
             metrics_path=args.metrics_path,
@@ -347,8 +364,17 @@ def _run(args, guard) -> int:
     print(f"slot occupancy: {server.occupancy_lifetime():.3f} "
           f"({args.slots} slot(s), chunk {args.chunk}, {mode}"
           + (f", qmode {args.qmode}" if args.qmode != "off" else "")
+          + (f", spec-depth {args.spec_depth}" if args.spec_depth else "")
           + ")",
           file=sys.stderr)
+    if args.spec_depth:
+        flat = server.metrics.counters_flat()
+        acc = flat.get("spec_accepted_total", 0)
+        rej = flat.get("spec_rejected_total", 0)
+        rate = acc / (acc + rej) if acc + rej else 0.0
+        print(f"speculation: {acc} draft(s) accepted, {rej} rejected "
+              f"(rate {rate:.3f}), {flat.get('spec_floor_total', 0)} "
+              "slot floor(s)", file=sys.stderr)
     if args.prefix_dir:
         flat = server.metrics.counters_flat()
         print(f"prefix cache: {flat.get('prefix_hits', 0)} hit(s), "

@@ -47,6 +47,17 @@ row evictions on one carry.
   requests produce tokens BITWISE-identical to N solo runs at the same
   seeds (tests/test_batching.py pins this for slots {2, 4, 8}, greedy and
   sampled, including late admission).
+- **self-speculation** (ISSUE 13) — with ``spec_depth > 0``, pure-decode
+  boundaries run a speculative round instead of the plain chunk: the
+  model's own global-linear layers draft up to k tokens per slot
+  (``transformer.draft_step``, shadow (S, z), no cache growth) and the
+  full model verifies them all in ONE batched piece whose logits are
+  BITWISE the plain walk's (``transformer.verify_step``), so emitted
+  tokens never change — only ms/tok does. Accepted counts ride the
+  per-boundary probe transfer; a per-slot rolling-acceptance floor
+  (``spec_min_accept``) drops losing slots back to plain decode; the
+  ladder, sessions, and qmode contracts all re-pin under speculation
+  (tests/test_spec_decode.py).
 
 The engine owns no threads and installs no handlers; the Server drives it
 from its scheduler loop and maps finished slots back onto Pendings.
@@ -67,6 +78,7 @@ from orion_tpu.generate import (
     bucket_for,
     decode_batched_chunk,
     decode_batched_prefill_chunk,
+    decode_batched_spec_round,
     prefill_carry,
     reprefill_carry,
 )
@@ -89,6 +101,19 @@ def _slot_flags(states, done) -> Array:
     """[2, slots] bool: per-slot finite mask stacked with the done flags —
     the engine's whole per-chunk host readback in ONE device transfer."""
     return jnp.stack([decode_state_finite_per_slot(states), done])
+
+
+@jax.jit
+def _spec_flags(states, done, accepted) -> Array:
+    """[3, slots] int32: the speculative boundary's whole host readback —
+    finite mask, done flags, AND per-slot accepted-draft counts — still
+    ONE device transfer per round (the accept/reject decision rides the
+    existing probe, never a second readback)."""
+    return jnp.stack([
+        decode_state_finite_per_slot(states).astype(jnp.int32),
+        done.astype(jnp.int32),
+        accepted,
+    ])
 
 
 @jax.jit
@@ -231,12 +256,18 @@ class _Slot:
     tag: Any
     deadline_at: Optional[float]
     prompt: Array  # [1, T] int32 (kept for the re-prefill rung)
-    # per-chunk (tokens [S, chunk], my row) — the row is NOT sliced at the
-    # boundary (that would cost O(slots) device calls per chunk on the
-    # scheduler's hot path) but lazily at eviction/re-prefill
-    toks: List[Tuple[Array, int]]
+    # per-boundary (tokens [S, W], my row, valid count) — the row is NOT
+    # sliced at the boundary (that would cost O(slots) device calls per
+    # chunk on the scheduler's hot path) but lazily at eviction/
+    # re-prefill; the valid count is ``chunk`` for plain boundaries and
+    # the accepted prefix + 1 for speculative rounds
+    toks: List[Tuple[Array, int, int]]
     n_emitted: int = 0
     chunks: int = 0  # request-local chunk index (fault-hook address)
+    # -- self-speculation bookkeeping (host mirrors of the probe row) --
+    spec_rounds: int = 0
+    spec_accepted: int = 0  # drafts accepted across this slot's rounds
+    spec_drafted: int = 0  # drafts proposed (rounds x depth while on)
     # prompt tokens the in-scan prefill has yet to consume (0 = decoding;
     # host-prefill admissions are always 0). The host mirror of the
     # device-side ``plen - t`` — deterministic, so no readback is needed
@@ -281,6 +312,8 @@ class SlotEngine:
         prompt_overflow: str = "error",
         on_event: Optional[Callable[[str, dict], None]] = None,
         prefix_store: Optional[Any] = None,
+        spec_depth: int = 0,
+        spec_min_accept: float = 0.0,
     ):
         assert slots > 0, slots
         assert chunk > 0, chunk
@@ -290,6 +323,49 @@ class SlotEngine:
         self.slots = int(slots)
         self.chunk = int(chunk)
         self._clock = clock
+        # self-speculative decode (ISSUE 13): at pure-decode boundaries
+        # the model's own global-linear sublayers draft up to spec_depth
+        # tokens per slot and the full hybrid verifies them in ONE
+        # batched piece — emitted tokens stay BITWISE the plain walk's
+        # (verification re-samples from the full model's logits at the
+        # same rng folds), so speculative and plain boundaries compose
+        # freely. spec_min_accept > 0 arms the per-slot adaptive floor:
+        # a slot whose rolling acceptance drops below it falls back to
+        # plain decode instead of paying a losing draft.
+        self.spec_depth = int(spec_depth)
+        self.spec_min_accept = float(spec_min_accept)
+        if self.spec_depth:
+            cfg_ = model.cfg
+            if self.spec_depth < 1:
+                raise ValueError(f"spec_depth must be >= 0: {spec_depth}")
+            if not any(
+                lt == "linear" for lt in cfg_.resolved_layer_types
+            ):
+                raise ValueError(
+                    "self-speculative decode drafts with the model's "
+                    "global-linear layers; this config has none "
+                    f"(layer_types={cfg_.resolved_layer_types})"
+                )
+            if cfg_.n_experts > 0:
+                raise ValueError(
+                    "self-speculative decode is dense-model only: MoE "
+                    "routing groups tokens across the verify piece's "
+                    "batch, so the piece cannot replay the per-token "
+                    "walk bitwise"
+                )
+            if (any(lt == "swa" for lt in cfg_.resolved_layer_types)
+                    and self.spec_depth + 1 > cfg_.window):
+                raise ValueError(
+                    f"spec_depth {self.spec_depth} + 1 exceeds the swa "
+                    f"window {cfg_.window}: a round's positions must hit "
+                    "distinct ring slots for the clamped advance to "
+                    "equal the sequential writes"
+                )
+        # per-slot rolling acceptance (EWMA) + the speculation enable
+        # mask the adaptive floor maintains; both reset at admission
+        self._accept_ewma: List[Optional[float]] = [None] * self.slots
+        self._spec_on_np = np.ones((self.slots,), bool)
+        self._accept_np: Optional[np.ndarray] = None
         # telemetry tap (obs/): called with (kind, fields) at admissions,
         # prefill-piece consumption, ladder rungs, and evictions — every
         # field is a HOST value the scheduler already holds (slot index,
@@ -451,6 +527,10 @@ class SlotEngine:
                 "request's SampleConfig differs from the resident batch's; "
                 "the slot scan's sampling parameters are static per batch"
             )
+        # a fresh occupant speculates from a clean slate: the previous
+        # request's rolling acceptance must not pre-floor it
+        self._accept_ewma[free[0]] = None
+        self._spec_on_np[free[0]] = True
         return free[0]
 
     def admit(
@@ -814,12 +894,22 @@ class SlotEngine:
         active = np.array([s is not None for s in self._slots])
         active_dev = jnp.asarray(active)
         unified = self.prefilling_count > 0
+        # speculative rounds run at PURE-DECODE boundaries only (the
+        # unified program owns mid-prefill boundaries); the bitwise
+        # contract makes the two interleave token-transparently. With
+        # every active slot floored the plain chunk program runs — full
+        # chunk per boundary, and its compiled bytes stay untouched.
+        spec = None
+        if self.spec_depth and not unified and bool(
+            np.any(active & self._spec_on_np)
+        ):
+            spec = jnp.asarray(self._spec_on_np)
         snap = self._snapshot()
-        carry, toks = self._attempt(snap, active_dev, unified)
-        bad = self._probe_bad(carry, active)
+        carry, toks, accepted = self._attempt(snap, active_dev, unified, spec)
+        bad = self._probe_bad(carry, active, accepted)
         if bad:
             carry, toks, bad = self._ladder(
-                snap, active_dev, active, carry, toks, bad, unified
+                snap, active_dev, active, carry, toks, bad, unified, spec
             )
             for i in sorted(bad):  # ladder exhausted: fail those requests
                 finished.append((self._slots[i].tag, self._finish(i, "failed")))
@@ -832,6 +922,8 @@ class SlotEngine:
         # host-mirrored inputs) tells which slot consumed the boundary's
         # prompt budget and hence the boundary each slot starts emitting
         sel = self._selected_prefill_slot(active)
+        spec_stats = None if spec is None else {"accepted": 0, "rejected": 0,
+                                                "slots": 0}
         for i, slot in enumerate(self._slots):
             if slot is None or not active[i]:
                 continue
@@ -846,16 +938,77 @@ class SlotEngine:
                            remaining=slot.prompt_remaining)
                 if slot.prompt_remaining > 0:
                     continue  # still mid-prefill: emitted nothing yet
-                slot.toks.append((toks, i))
+                slot.toks.append((toks, i, self.chunk))
                 slot.n_emitted += self.chunk
+            elif spec is not None:
+                # speculative round: the probe's accepted row says how
+                # far this slot advanced (accepted drafts + the pending
+                # token); the host mirror drives the rolling-acceptance
+                # floor without any extra readback
+                v = int(self._accept_np[i]) + 1
+                slot.toks.append((toks, i, v))
+                slot.n_emitted += v
+                slot.chunks += 1
+                if self._spec_on_np[i]:
+                    spec_stats["slots"] += 1
+                    spec_stats["accepted"] += v - 1
+                    spec_stats["rejected"] += self.spec_depth - (v - 1)
+                    self._update_spec_accept(i, v - 1)
             else:
-                slot.toks.append((toks, i))
+                slot.toks.append((toks, i, self.chunk))
                 slot.n_emitted += self.chunk
                 slot.chunks += 1
             if slot.n_emitted >= slot.target_new or done_np[i]:
                 finished.append((slot.tag, self._finish(i, "ok")))
+        if spec_stats is not None and spec_stats["slots"]:
+            self._emit("spec_round", depth=self.spec_depth, **spec_stats)
         self._chunk_counter += 1
         return finished
+
+    def _update_spec_accept(self, i: int, accepted: int) -> None:
+        """Fold one round's acceptance into slot ``i``'s rolling EWMA and
+        apply the adaptive floor: a slot paying for drafts that keep
+        being rejected falls back to plain decode for the rest of its
+        residency (``spec_min_accept``; 0 never floors). Pure host
+        arithmetic on the probe row the boundary already paid for."""
+        slot = self._slots[i]
+        slot.spec_rounds += 1
+        slot.spec_accepted += accepted
+        slot.spec_drafted += self.spec_depth
+        rate = accepted / max(self.spec_depth, 1)
+        prev = self._accept_ewma[i]
+        ewma = rate if prev is None else 0.5 * prev + 0.5 * rate
+        self._accept_ewma[i] = ewma
+        # >= 2 rounds before flooring: one unlucky first round must not
+        # permanently disable a slot's speculation
+        if (self.spec_min_accept > 0.0 and slot.spec_rounds >= 2
+                and self._spec_on_np[i]
+                and ewma < self.spec_min_accept):
+            self._spec_on_np[i] = False
+            self._emit("spec_floor", slot=i, tag=slot.tag,
+                       accept_ewma=round(ewma, 4),
+                       rounds=slot.spec_rounds)
+
+    def spec_info(self) -> List[dict]:
+        """Per-resident-slot speculation view for /statusz: depth, the
+        enable bit, rolling acceptance, and lifetime accept counts. Pure
+        host bookkeeping, no readback."""
+        out = []
+        if not self.spec_depth:
+            return out
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            e = self._accept_ewma[i]
+            out.append({
+                "slot": i, "depth": self.spec_depth,
+                "on": bool(self._spec_on_np[i]),
+                "accept_ewma": None if e is None else round(e, 4),
+                "rounds": slot.spec_rounds,
+                "accepted": slot.spec_accepted,
+                "drafted": slot.spec_drafted,
+            })
+        return out
 
     def _piece_tokens(self) -> int:
         """The boundary's TOTAL prompt-token budget (Sarathi-style
@@ -892,14 +1045,22 @@ class SlotEngine:
         token, states, t, emit, done = self._carry
         return (token, snapshot_decode_state(states), t, emit, done)
 
-    def _attempt(self, carry, active_dev, unified=False):
-        """One batched chunk attempt — the UNIFIED prefill+decode program
-        while any slot is mid-prefill, the pure decode program otherwise
-        (whose compiled bytes this feature must not perturb; golden
-        ``decode_batched_tiny``). Applies any armed per-slot (or legacy
-        per-chunk) decode-state poisoning afterwards so each ladder rung
-        is deterministically reachable per slot."""
-        if unified:
+    def _attempt(self, carry, active_dev, unified=False, spec=None):
+        """One batched boundary attempt — the UNIFIED prefill+decode
+        program while any slot is mid-prefill, the SPECULATIVE round
+        when ``spec`` (the per-slot speculation mask) is armed, the pure
+        decode program otherwise (whose compiled bytes this feature must
+        not perturb; golden ``decode_batched_tiny``). Returns
+        (carry, emitted, accepted-or-None). Applies any armed per-slot
+        (or legacy per-chunk) decode-state poisoning afterwards so each
+        ladder rung is deterministically reachable per slot."""
+        accepted = None
+        if spec is not None:
+            out, toks, accepted = decode_batched_spec_round(
+                self.model, self.params, carry, self._rngs, active_dev,
+                spec, self.spec_depth, self._sample,
+            )
+        elif unified:
             out, toks = decode_batched_prefill_chunk(
                 self.model, self.params, carry, self._rngs, active_dev,
                 self._pbuf, self._plen, self._pfold, self.chunk,
@@ -918,7 +1079,7 @@ class SlotEngine:
                     inject.decode_nan_armed(slot.chunks)
                 ):
                     out = self._poison_slot(out, i)
-        return out, toks
+        return out, toks, accepted
 
     @staticmethod
     def _poison_slot(carry, i: int):
@@ -930,30 +1091,43 @@ class SlotEngine:
         )
         return (token, states, t, emit, done)
 
-    def _probe_bad(self, carry, active: np.ndarray) -> set:
-        """The designated per-chunk host sync: ONE [2, slots]-bool
-        transfer carrying the per-slot finite mask (free slots masked — a
-        failed request's NaN remains in its row until the next admission
-        overwrites it) AND the done flags (EOS already emitted -> every
-        later token is PAD, so the slot can be freed and the tail filled
-        host-side); the done row is stashed for the eviction pass."""
-        flags = np.asarray(_slot_flags(carry[1], carry[4]))
-        self._done_np = flags[1]
-        finite = flags[0]
+    def _probe_bad(self, carry, active: np.ndarray, accepted=None) -> set:
+        """The designated per-chunk host sync: ONE transfer carrying the
+        per-slot finite mask (free slots masked — a failed request's NaN
+        remains in its row until the next admission overwrites it) AND
+        the done flags (EOS already emitted -> every later token is PAD,
+        so the slot can be freed and the tail filled host-side); the
+        done row is stashed for the eviction pass. At a speculative
+        boundary the per-slot accepted counts ride the SAME transfer
+        ([3, slots] int32 instead of [2, slots] bool) — the accept/
+        reject decision never costs a second readback."""
+        if accepted is None:
+            flags = np.asarray(_slot_flags(carry[1], carry[4]))
+            self._done_np = flags[1]
+            self._accept_np = None
+            finite = flags[0]
+        else:
+            flags = np.asarray(_spec_flags(carry[1], carry[4], accepted))
+            self._done_np = flags[1].astype(bool)
+            self._accept_np = flags[2]
+            finite = flags[0].astype(bool)
         return {i for i in range(self.slots) if active[i] and not finite[i]}
 
-    def _ladder(self, snap, active_dev, active, carry, toks, bad, unified=False):
+    def _ladder(self, snap, active_dev, active, carry, toks, bad,
+                unified=False, spec=None):
         """Walk the per-slot degradation ladder. Redoing the WHOLE batched
         chunk from the boundary snapshot is the rewind: deterministic
         row-independent compute means untouched slots reproduce their
         tokens bitwise (a co-resident slot MID-prefill replays its piece
         identically — the staged prompt and its position are part of the
-        snapshot's inputs), and the poisoned slot gets its retry. Returns
+        snapshot's inputs; a co-resident slot MID-SPECULATION re-drafts
+        and re-verifies identically — drafts are a pure function of the
+        snapshot carry), and the poisoned slot gets its retry. Returns
         the accepted (carry, toks) and the set of slots whose ladder is
         exhausted (their requests fail; everyone else streams on)."""
         # rung 1: rewind — redo from the snapshot
-        carry, toks = self._attempt(snap, active_dev, unified)
-        bad2 = self._probe_bad(carry, active)
+        carry, toks, accepted = self._attempt(snap, active_dev, unified, spec)
+        bad2 = self._probe_bad(carry, active, accepted)
         for i in bad:
             self._slots[i].rewinds += 1
             self._emit("ladder", rung="rewind", slot=i,
@@ -971,8 +1145,8 @@ class SlotEngine:
                     and self.prefill_chunk else "reprefill")
             self._emit("ladder", rung=rung, slot=i,
                        chunk=self._slots[i].chunks, tag=self._slots[i].tag)
-        carry, toks = self._attempt(snap2, active_dev, unified)
-        bad3 = self._probe_bad(carry, active)
+        carry, toks, accepted = self._attempt(snap2, active_dev, unified, spec)
+        bad3 = self._probe_bad(carry, active, accepted)
         if not bad3:
             return carry, toks, set()
         # rung 3: fail the exhausted slots and redo once more with them
@@ -983,7 +1157,13 @@ class SlotEngine:
             self._emit("ladder", rung="exhausted", slot=i,
                        chunk=self._slots[i].chunks, tag=self._slots[i].tag)
         if still.any():
-            carry, toks = self._attempt(snap2, jnp.asarray(still), unified)
+            # the surviving slots' tokens, done flags, and accepted
+            # counts replay bitwise (row-independence), so the stashed
+            # probe rows from the accepted attempt above stay valid —
+            # no extra readback for the rung-3 replay
+            carry, toks, _ = self._attempt(
+                snap2, jnp.asarray(still), unified, spec
+            )
         return carry, toks, bad3
 
     def _reprefill_into(self, snap, i: int):
@@ -1005,7 +1185,7 @@ class SlotEngine:
             slot.prompt_remaining = slot.prompt.shape[1]
             return _restart_prefill_row(snap, jnp.int32(i))
         emitted = list(slot.prior) + [
-            arr[row : row + 1] for arr, row in slot.toks
+            arr[row : row + 1, :n] for arr, row, n in slot.toks
         ]
         rng = jax.random.PRNGKey(slot.seed)
         fold = slot.fold_base + slot.n_emitted
@@ -1034,7 +1214,9 @@ class SlotEngine:
         req = slot.request
         want = req.max_new_tokens
         parts = [] if slot.prefix is None else [slot.prefix]
-        parts += [np.asarray(arr)[row : row + 1] for arr, row in slot.toks]
+        parts += [
+            np.asarray(arr)[row : row + 1, :n] for arr, row, n in slot.toks
+        ]
         if parts:
             tokens = np.concatenate(parts, axis=1)[:, :want]
         else:
@@ -1070,6 +1252,8 @@ class SlotEngine:
             session=slot.session_id, chunks=slot.chunks,
             suspended=(slot.session_id is not None and status != "failed"
                        and slot.prompt_remaining == 0),
+            spec_accepted=slot.spec_accepted,
+            spec_drafted=slot.spec_drafted,
         )
         if (slot.session_id is None or status == "failed"
                 or slot.prompt_remaining > 0):
@@ -1087,7 +1271,9 @@ class SlotEngine:
             _extract_carry(self._carry, jnp.int32(i))
         )
         prior = [np.asarray(a) for a in slot.prior]
-        rows = [np.asarray(arr)[row : row + 1] for arr, row in slot.toks]
+        rows = [
+            np.asarray(arr)[row : row + 1, :n] for arr, row, n in slot.toks
+        ]
         emitted = (
             np.concatenate(prior + rows, axis=1)
             if prior or rows

@@ -172,6 +172,21 @@ class ServeConfig:
     # consecutive chunk-boundary evaluations with a fast-burn alert
     # firing before the server degrades itself and sheds early
     slo_degrade_ticks: int = 3
+    # -- self-speculative decode (ISSUE 13): the hybrid's global-linear
+    # sublayers draft up to spec_depth tokens per slot and the full
+    # model verifies them in ONE batched piece at pure-decode
+    # boundaries. Emitted tokens are BITWISE the plain walk's (greedy
+    # AND sampled — verification re-samples from the full model's
+    # logits at the same rng folds), so speculation changes speed,
+    # never output. 0 = off. Dense models with >= 1 linear layer only;
+    # needs spec_depth + 1 <= window on swa configs.
+    spec_depth: int = 0
+    # per-slot adaptive floor: when a slot's rolling (EWMA) acceptance
+    # drops below this, it falls back to plain decode for the rest of
+    # its residency instead of paying a losing draft. The default is
+    # conservative — a draft accepting under ~1 token in 5 costs more
+    # than it saves on any realistic cost ratio. 0 disables the floor.
+    spec_min_accept: float = 0.2
 
 
 @dataclasses.dataclass
@@ -317,6 +332,19 @@ class Server:
             prefill_chunk=cfg.prefill_chunk,
             prompt_overflow=cfg.prompt_overflow,
             on_event=self._on_engine_event,
+            spec_depth=cfg.spec_depth,
+            spec_min_accept=cfg.spec_min_accept,
+        )
+        # self-speculation telemetry (ISSUE 13): totals for the SLO
+        # engine's rate views plus a per-turn acceptance-rate histogram
+        # — when speculation stops paying, the acceptance collapse is
+        # visible before the latency regression is
+        self._c_spec_accepted = self.metrics.counter("spec_accepted_total")
+        self._c_spec_rejected = self.metrics.counter("spec_rejected_total")
+        self._c_spec_floors = self.metrics.counter("spec_floor_total")
+        self._h_spec_accept = self.metrics.histogram(
+            "spec_accept_rate",
+            buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
         )
         # content-addressed prefix cache: one store per prefix_dir,
         # shared across replicas; entries are aligned to the engine's
@@ -474,6 +502,16 @@ class Server:
         /metrics where a scraper wants it."""
         snap = self.snapshot()
         snap.pop("metrics", None)
+        if self.cfg.spec_depth:
+            flat = self.metrics.counters_flat()
+            snap["speculation"] = {
+                "depth": self.cfg.spec_depth,
+                "min_accept": self.cfg.spec_min_accept,
+                "accepted_total": flat.get("spec_accepted_total", 0),
+                "rejected_total": flat.get("spec_rejected_total", 0),
+                "floors_total": flat.get("spec_floor_total", 0),
+                "slots": self.engine.spec_info(),
+            }
         snap["flight_tail"] = self.flight.events()[-20:]
         return snap
 
@@ -498,7 +536,30 @@ class Server:
         rid = getattr(tag, "rid", None)
         if rid is not None:
             fields["req"] = rid
+        if kind == "spec_round":
+            # totals every round; the flight ring records only rounds
+            # with draft REJECTIONS (each is a rewind-shaped event — the
+            # carry clamped at the accepted prefix) so the black box
+            # keeps signal, not a per-round heartbeat
+            self._c_spec_accepted.inc(fields.get("accepted", 0))
+            self._c_spec_rejected.inc(fields.get("rejected", 0))
+            if fields.get("rejected", 0):
+                self.flight.record("spec_reject", **fields)
+            return
         self.flight.record(kind, **fields)
+        if kind == "spec_floor":
+            self._c_spec_floors.inc()
+            self.trace.instant("spec_floor", id=rid,
+                               slot=fields.get("slot"),
+                               accept=fields.get("accept_ewma"))
+            return
+        if kind == "evict" and fields.get("spec_drafted", 0):
+            # per-turn acceptance: one observation per request that
+            # actually speculated — the histogram the SLO engine can
+            # window to see acceptance collapse
+            self._h_spec_accept.observe(
+                fields["spec_accepted"] / fields["spec_drafted"]
+            )
         if kind == "ladder":
             self._c_ladder.inc(labels={"rung": fields.get("rung", "?")})
             self.trace.instant("ladder", id=rid, rung=fields.get("rung"),

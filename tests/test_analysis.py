@@ -867,6 +867,60 @@ def publish_prefix(store, tokens, state):
     )
 
 
+def test_decode_host_sync_spec_paths_are_sync_free():
+    """ISSUE 13: the self-speculation paths — draft pass, verify piece,
+    spec-round bookkeeping — must make the accept/reject decision from
+    the existing single per-chunk probe transfer. Any host sync inside a
+    draft/verify/spec-named function of serving/batching.py is a finding
+    even outside a loop; probe-named functions stay the designated sync
+    point."""
+    synced = """
+import numpy as np
+
+def _attempt_spec(engine, carry):
+    out, toks, accepted = engine.spec_round(carry)
+    return out, np.asarray(accepted)
+
+def _draft_ahead(engine, carry):
+    return float(engine.draft(carry))
+
+def _verify_piece(engine, fed):
+    return engine.logits(fed).item()
+"""
+    found = rule_ids(
+        lint_source(synced, path="orion_tpu/serving/batching.py")
+    )
+    assert "decode-host-sync" in found
+    assert len([f for f in lint_source(
+        synced, path="orion_tpu/serving/batching.py"
+    ) if f.rule == "decode-host-sync"]) == 3
+    # the clean shape: the round dispatches device work; the accepted
+    # counts come back through the probe's stacked transfer
+    clean = """
+import jax.numpy as jnp
+
+def _attempt_spec(engine, carry, active):
+    return engine.spec_round(carry, jnp.asarray(active))
+
+def _update_spec_accept(engine, i, accepted):
+    engine.ewma[i] = 0.5 * (engine.ewma[i] or accepted) + 0.5 * accepted
+
+def spec_info(engine):
+    return [dict(slot=i, on=bool(b)) for i, b in enumerate(engine.on)]
+
+def _probe_bad_spec(engine, carry, accepted):
+    import numpy as np
+    return np.asarray(engine.flags(carry, accepted))  # designated sync
+"""
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(clean, path="orion_tpu/serving/batching.py")
+    )
+    # spec-named helpers OUTSIDE the engine module keep loop scope only
+    assert "decode-host-sync" not in rule_ids(
+        lint_source(synced, path="orion_tpu/serving/server.py")
+    )
+
+
 def test_loop_accum_only_fires_on_hot_paths():
     src = """
 import jax.numpy as jnp
@@ -1389,6 +1443,25 @@ def test_quant_decode_goldens_pin_the_serving_contract(fresh_snapshots):
     # its dot count strictly exceeds int8's single-dot-per-matmul form
     assert (fresh_snapshots["decode_batched_int4"]["op_histogram"]["dot"]
             > fresh_snapshots["decode_batched_int8"]["op_histogram"]["dot"])
+
+
+def test_spec_decode_golden_pins_the_verify_contract(fresh_snapshots):
+    """ISSUE 13: the speculative-round artifact pins (a) ZERO
+    collectives — the draft pass and the batched verify piece never
+    communicate — and (b) a largest scan carry that does NOT exceed the
+    plain batched decode's: the draft scan threads shadow copies of the
+    carry's own (S, z) rows (no growth — speculation adds no state) and
+    the verify's inner scans carry one layer's state at a time."""
+    spec = fresh_snapshots["decode_batched_spec_tiny"]
+    plain = fresh_snapshots["decode_batched_tiny"]
+    assert all(v == 0 for v in spec["hlo_collectives"].values()), (
+        "the verify step must not communicate"
+    )
+    assert spec["scan_carry_bytes"] <= plain["scan_carry_bytes"], (
+        "speculation must not grow the decode carry: the draft rides "
+        "the SAME (S, z)"
+    )
+    assert spec["spec_depth"] == 4 and spec["slots"] == 8
 
 
 def test_donated_arg_aliasing_recorded_and_checked(fresh_snapshots):

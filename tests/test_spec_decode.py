@@ -1,0 +1,628 @@
+"""Self-speculative decode suite (ISSUE 13): the cheap linear layers
+draft, one batched piece verifies.
+
+THE acceptance proofs live here — (1) speculative output is BITWISE
+identical to non-speculative decode at slots {1, 4, 8} under staggered
+admission, GREEDY and SAMPLED alike (verification re-samples from the
+full model's logits at the same rng folds, so the emitted tokens are
+always the plain walk's tokens; rejected drafts are never observable);
+(2) the structural foundation — ``transformer.verify_step``'s logits and
+``advance_verified_states``' clamped advance are bitwise what P
+successive ``decode_step`` calls produce — pinned at the model level;
+(3) the machinery composes: ladder rungs 1/2 on a mid-speculation slot
+rewind bitwise, SIGTERM drain mid-speculation suspends at the boundary
+and a restarted server resumes bitwise, and both quantized modes
+(int8/int4) hold the same parity. Plus the adaptive acceptance floor
+(scripted adversarial stream), the compile budget (one spec program per
+(slots, depth); the plain program's cache untouched), and the carry
+linearity the golden snapshot companion pins.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.generate import (
+    SampleConfig,
+    _decode_batched_chunk_jit,
+    _decode_batched_spec_round_jit,
+    generate,
+    quantize_for_decode,
+)
+from orion_tpu.models.configs import ModelConfig
+from orion_tpu.models.transformer import (
+    TransformerLM,
+    init_decode_state,
+    linear_layer_indices,
+)
+from orion_tpu.resilience import inject
+from orion_tpu.serving import (
+    DecodeRequest,
+    Health,
+    ServeConfig,
+    Server,
+    SlotEngine,
+    parse_buckets,
+)
+
+pytestmark = pytest.mark.chaos
+
+# layer-diverse so the verify piece and the clamped advance cross every
+# decode-state flavour — (S, z), full KV cache, swa ring. DELIBERATELY
+# the exact shape family of tests/test_batching.py (flax modules hash by
+# config, so the solo-reference `generate` / prefill / plain-chunk
+# compiles are SHARED with that suite in one quick-tier process — only
+# the draft/verify programs compile fresh here); window 4 admits depths
+# up to 3 (the ring scatter needs depth + 1 <= window).
+CFG = ModelConfig(
+    name="batch_test", vocab_size=64, d_model=32, n_layers=3, n_heads=2,
+    layer_types=("linear", "softmax", "swa"), window=4, max_seq_len=64,
+    dtype="float32", backend="xla",
+)
+GREEDY = SampleConfig(temperature=0.0)
+SAMPLED = SampleConfig(temperature=0.8, top_k=5, top_p=0.9, eos_token=3,
+                       pad_token=0)
+DEPTH = 3
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = TransformerLM(CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _prompts(n):
+    return [
+        jax.random.randint(
+            jax.random.PRNGKey(1000 + i), (1, 3 + (i % 5)), 0, CFG.vocab_size
+        ).astype(jnp.int32)
+        for i in range(n)
+    ]
+
+
+def _solo_refs(mp, prompts, n_new, sample):
+    model, params = mp
+    return [
+        np.asarray(
+            generate(model, params, p, n_new, sample,
+                     rng=jax.random.PRNGKey(500 + i))
+        )
+        for i, p in enumerate(prompts)
+    ]
+
+
+def _spec_cfg(**kw):
+    base = dict(chunk=4, slots=4, max_inflight=8, spec_depth=DEPTH,
+                spec_min_accept=0.0)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# structural foundation: the verify piece IS the decode walk, bitwise
+# ---------------------------------------------------------------------------
+
+
+def test_verify_step_bitwise_vs_sequential_decode(mp):
+    """The contract everything rests on: verify_step's per-position
+    logits equal P successive decode_step calls BITWISE (projections as
+    P-row gemms are row-stable; the state recurrence replays
+    decode_step's op sequence), and the clamped advance lands exactly
+    the accepted prefix's updates — per-row, any keep."""
+    model, params = mp
+    S, P = 4, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (S, 8), 0, 64)
+    _, states = model.apply(params, prompt, method="prefill_last")
+    t0 = jnp.full((S,), 8, jnp.int32)
+    fed = jax.random.randint(jax.random.PRNGKey(3), (S, P), 0, 64)
+    ds = jax.jit(lambda tk, st, t: model.apply(
+        params, tk, st, t, method="decode_step"))
+    vs = jax.jit(lambda fd, st, t: model.apply(
+        params, fd, st, t, method="verify_step"))
+    adv = jax.jit(lambda st, up, t, keep: model.apply(
+        params, st, up, t, keep, method="advance_verified_states"))
+    # sequential reference walk, teacher-forced on the same tokens
+    seq_states = [states]
+    ref_logits = []
+    st = states
+    for j in range(P):
+        lg, st = ds(fed[:, j], st, t0 + j)
+        ref_logits.append(lg)
+        seq_states.append(st)
+    ref_logits = jnp.stack(ref_logits, axis=1)
+    logits, upds = vs(fed, states, t0)
+    assert bool(jnp.all(logits == ref_logits)), (
+        "verify logits must be bitwise the sequential decode walk's"
+    )
+    # clamped advance: every uniform keep, plus a mixed per-row keep
+    for kp in range(P + 1):
+        got = adv(states, upds, t0, jnp.full((S,), kp, jnp.int32))
+        same = jax.tree.map(
+            lambda a, b: bool(jnp.all(a == b)), got, seq_states[kp]
+        )
+        assert jax.tree.reduce(lambda a, b: a and b, same), f"keep={kp}"
+    keep = jnp.asarray([0, 1, 3, 4], jnp.int32)
+    got = adv(states, upds, t0, keep)
+    for i in range(S):
+        want = jax.tree.map(lambda x: x[i], seq_states[int(keep[i])])
+        have = jax.tree.map(lambda x: x[i], got)
+        same = jax.tree.map(lambda a, b: bool(jnp.all(a == b)), have, want)
+        assert jax.tree.reduce(lambda a, b: a and b, same), f"row {i}"
+
+
+def test_draft_step_runs_linear_trunk_only(mp):
+    """draft_step touches only the linear layers' (S, z): its state list
+    matches the linear sublayers and softmax/swa caches are never read
+    or written (a NaN-poisoned cache must not leak into draft logits)."""
+    model, params = mp
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, 64)
+    _, states = model.apply(params, prompt, method="prefill_last")
+    lin = linear_layer_indices(CFG)
+    assert lin == (0,)
+    lin_states = [states[i] for i in lin]
+    t = jnp.full((2,), 8, jnp.int32)
+    tok = jnp.ones((2,), jnp.int32)
+    dj = jax.jit(lambda tk, st, tt: model.apply(
+        params, tk, st, tt, method="draft_step"))
+    lg, new = dj(tok, lin_states, t)
+    assert lg.shape == (2, CFG.vocab_size)
+    assert len(new) == 1 and set(new[0]) == {"s", "z"}
+    # poison every cache leaf: the draft must not notice
+    poisoned = [
+        st if i in lin else jax.tree.map(lambda x: x * jnp.nan, st)
+        for i, st in enumerate(states)
+    ]
+    lg2, _ = dj(tok, [poisoned[i] for i in lin], t)
+    assert bool(jnp.all(lg == lg2)) and bool(jnp.all(jnp.isfinite(lg2)))
+
+
+# ---------------------------------------------------------------------------
+# acceptance: bitwise speculative-vs-plain parity at slots {1, 4, 8}
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("slots", [1, 4, 8])
+@pytest.mark.parametrize("sample", [GREEDY, SAMPLED], ids=["greedy", "sampled"])
+def test_spec_parity_bitwise(mp, slots, sample):
+    """THE acceptance proof: N > slots requests through a speculating
+    Server (staggered admission — freed slots refill at boundaries, so
+    late requests join mid-stream at nonzero positions beside slots deep
+    in their own speculation) come out BITWISE what the monolithic solo
+    scan produces at the same seeds, greedy AND sampled."""
+    model, params = mp
+    n = slots + 2
+    prompts = _prompts(n)
+    refs = _solo_refs(mp, prompts, 8, sample)
+    srv = Server(model, params, _spec_cfg(slots=slots, max_inflight=n))
+    ps = [
+        srv.submit(DecodeRequest(prompt=p, max_new_tokens=8, sample=sample,
+                                 seed=500 + i))
+        for i, p in enumerate(prompts)
+    ]
+    assert srv.serve(drain_when_idle=True) == 0
+    for i, (p, ref) in enumerate(zip(ps, refs)):
+        assert p.result is not None and p.result.status == "ok", i
+        np.testing.assert_array_equal(p.result.tokens, ref,
+                                      err_msg=f"request {i}")
+    flat = srv.metrics.counters_flat()
+    total = flat.get("spec_accepted_total", 0) + flat.get(
+        "spec_rejected_total", 0
+    )
+    assert total > 0, "speculation must actually have run"
+    srv.close()
+
+
+def test_spec_parity_with_inscan_prefill(mp):
+    """Mid-prefill boundaries ride the unified program, pure-decode
+    boundaries the speculative round — and because both walks are
+    bitwise the plain walk, the interleaving is token-transparent."""
+    model, params = mp
+    prompts = _prompts(4)
+    refs = _solo_refs(mp, prompts, 8, GREEDY)
+    eng = SlotEngine(model, params, slots=2, chunk=4, spec_depth=DEPTH,
+                     prefill_buckets=parse_buckets("pow2", CFG.max_seq_len),
+                     prefill_chunk=8)
+    done, pend = {}, list(enumerate(prompts))
+    while pend or eng.busy:
+        while pend and eng.has_free_slot:
+            i, p = pend.pop(0)
+            eng.admit(DecodeRequest(prompt=p, max_new_tokens=8,
+                                    sample=GREEDY, seed=500 + i), tag=i)
+        done.update(dict(eng.step()))
+    for i in range(4):
+        assert done[i].status == "ok"
+        np.testing.assert_array_equal(done[i].tokens, refs[i],
+                                      err_msg=f"request {i}")
+
+
+def test_spec_rounds_interleave_with_plain_boundaries(mp):
+    """A slot suspended between round pacings stays bitwise: run one
+    engine with spec on, another alternating spec on/off via the floor
+    mask — tokens must agree (round boundaries are invisible)."""
+    model, params = mp
+    prompts = _prompts(2)
+    refs = _solo_refs(mp, prompts, 8, GREEDY)
+    eng = SlotEngine(model, params, slots=2, chunk=4, spec_depth=DEPTH)
+    for i, p in enumerate(prompts):
+        eng.admit(DecodeRequest(prompt=p, max_new_tokens=8, sample=GREEDY,
+                                seed=500 + i), tag=i)
+    done, flip = {}, False
+    while eng.busy:
+        # adversarially flap the speculation mask between boundaries:
+        # the bitwise contract makes the pacing unobservable in tokens
+        eng._spec_on_np[:] = flip
+        flip = not flip
+        done.update(dict(eng.step()))
+    for i in range(2):
+        np.testing.assert_array_equal(done[i].tokens, refs[i])
+
+
+# ---------------------------------------------------------------------------
+# compile budget: one spec program per (slots, depth); plain untouched
+# ---------------------------------------------------------------------------
+
+
+def test_one_spec_compile_per_depth(mp):
+    """A speculating engine's lifetime costs ONE spec-round compile per
+    (slots, depth) no matter the arrival order or acceptance pattern —
+    and the plain decode program gains NOTHING while speculation owns
+    every pure-decode boundary."""
+    model, params = mp
+    before_spec = _decode_batched_spec_round_jit._cache_size()
+    before_plain = _decode_batched_chunk_jit._cache_size()
+    # a (slots, depth) shape no other test in this module compiles, so
+    # the cache delta isolates THIS engine's lifetime
+    eng = SlotEngine(model, params, slots=3, chunk=4, spec_depth=2)
+    done = {}
+    for wave in range(2):
+        for i, p in enumerate(_prompts(2)):
+            eng.admit(DecodeRequest(prompt=p, max_new_tokens=8,
+                                    sample=GREEDY, seed=wave * 10 + i),
+                      tag=(wave, i))
+        while eng.busy:
+            done.update(dict(eng.step()))
+    assert all(r.status == "ok" for r in done.values())
+    assert _decode_batched_spec_round_jit._cache_size() - before_spec == 1, (
+        "one speculative-round compile per (slots, depth)"
+    )
+    assert _decode_batched_chunk_jit._cache_size() == before_plain, (
+        "speculation must not touch the plain decode program's cache"
+    )
+
+
+def test_spec_carry_bytes_scale_linearly_in_slots():
+    """Golden-snapshot companion (jaxpr only, no XLA compile): the
+    speculative round's largest scan carry is exactly slots x the
+    per-slot O(1) state — the draft threads the SAME (S, z), no
+    speculation-time state is invented."""
+    from functools import partial
+
+    from orion_tpu.analysis.snapshots import _carry_bytes
+
+    model = TransformerLM(CFG)
+    params = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, 8), jnp.int32),
+    )
+
+    def carry_bytes(slots):
+        states = jax.eval_shape(partial(init_decode_state, CFG, slots))
+        vec = lambda dt: jax.ShapeDtypeStruct((slots,), dt)  # noqa: E731
+        carry = (vec(jnp.int32), states, vec(jnp.int32), vec(jnp.int32),
+                 vec(jnp.bool_))
+        jaxpr = jax.make_jaxpr(
+            _decode_batched_spec_round_jit, static_argnums=(0, 6, 7)
+        )(model, params, carry, jax.ShapeDtypeStruct((slots, 2), jnp.uint32),
+          vec(jnp.bool_), vec(jnp.bool_), DEPTH, GREEDY)
+        return _carry_bytes(jaxpr)
+
+    one, eight = carry_bytes(1), carry_bytes(8)
+    assert eight == 8 * one, (one, eight)
+
+
+# ---------------------------------------------------------------------------
+# ladder rungs on a mid-speculation slot
+# ---------------------------------------------------------------------------
+
+
+def test_spec_poisoned_slot_rewinds_bitwise(mp):
+    """Ladder rung 1 at a speculative boundary: the whole round —
+    drafts, verify, clamp — replays from the snapshot; the poisoned
+    slot's retry and both co-residents come out bitwise."""
+    model, params = mp
+    prompts = _prompts(3)
+    refs = _solo_refs(mp, prompts, 8, GREEDY)
+    eng = SlotEngine(model, params, slots=4, chunk=4, spec_depth=DEPTH)
+    for i, p in enumerate(prompts):
+        eng.admit(DecodeRequest(prompt=p, max_new_tokens=8, sample=GREEDY,
+                                seed=500 + i), tag=i)
+    plan = inject.FaultPlan().poison_decode_slot_at(1, chunk=1)
+    done = {}
+    with inject.inject(plan):
+        while eng.busy:
+            done.update(dict(eng.step()))
+    assert plan.delivered == ["decode.slot_nan.1@1"]
+    for i in range(3):
+        assert done[i].status == "ok"
+        np.testing.assert_array_equal(done[i].tokens, refs[i],
+                                      err_msg=f"request {i}")
+    assert done[1].rewinds == 1 and done[1].reprefills == 0
+    assert done[0].rewinds == 0 and done[2].rewinds == 0
+
+
+def test_spec_poisoned_slot_escalates_to_reprefill_bitwise(mp):
+    """Ladder rung 2 mid-speculation: the re-prefill rebuilds the slot
+    from its prompt + the VARIABLE-length round emissions (the accepted
+    counts drive the fold index), and the walk still lands bitwise."""
+    model, params = mp
+    prompts = _prompts(2)
+    refs = _solo_refs(mp, prompts, 8, GREEDY)
+    eng = SlotEngine(model, params, slots=2, chunk=4, spec_depth=DEPTH)
+    for i, p in enumerate(prompts):
+        eng.admit(DecodeRequest(prompt=p, max_new_tokens=8, sample=GREEDY,
+                                seed=500 + i), tag=i)
+    plan = inject.FaultPlan().poison_decode_slot_at(1, chunk=1, times=2)
+    done = {}
+    with inject.inject(plan):
+        while eng.busy:
+            done.update(dict(eng.step()))
+    assert done[1].status == "ok"
+    assert (done[1].rewinds, done[1].reprefills) == (1, 1)
+    for i in range(2):
+        np.testing.assert_array_equal(done[i].tokens, refs[i])
+
+
+def test_spec_exhausted_ladder_fails_one_slot_others_stream(mp):
+    model, params = mp
+    prompts = _prompts(2)
+    refs = _solo_refs(mp, prompts, 8, GREEDY)
+    eng = SlotEngine(model, params, slots=2, chunk=4, spec_depth=DEPTH)
+    for i, p in enumerate(prompts):
+        eng.admit(DecodeRequest(prompt=p, max_new_tokens=8, sample=GREEDY,
+                                seed=500 + i), tag=i)
+    plan = inject.FaultPlan().poison_decode_slot_at(0, chunk=1, times=-1)
+    done = {}
+    with inject.inject(plan):
+        while eng.busy:
+            done.update(dict(eng.step()))
+    assert done[0].status == "failed"
+    # the finite rounds before the fault are kept, bitwise
+    kept = done[0].new_tokens
+    assert kept > 0
+    np.testing.assert_array_equal(done[0].tokens, refs[0][:, :kept])
+    assert done[1].status == "ok"
+    np.testing.assert_array_equal(done[1].tokens, refs[1])
+
+
+# ---------------------------------------------------------------------------
+# drain mid-speculation: suspend at the boundary, resume bitwise
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sample", [GREEDY, SAMPLED], ids=["greedy", "sampled"])
+def test_sigterm_mid_speculation_suspends_and_resumes_bitwise(
+    mp, tmp_path, sample
+):
+    """SIGTERM while every slot is mid-speculation: sessions suspend at
+    the NEXT round boundary (partial tokens out, one O(1) snapshot
+    each), the server exits 0, and a restarted speculating server
+    resumes each conversation; concatenated outputs are bitwise the
+    uninterrupted solo run — round pacing differs after the resume
+    (drafts restart from the resumed carry), tokens cannot."""
+    model, params = mp
+    want = 24
+    prompts = _prompts(2)
+    refs = _solo_refs(mp, prompts, want, sample)
+    cfg = _spec_cfg(slots=2, session_dir=str(tmp_path / "sess"))
+    srv1 = Server(model, params, cfg)
+    ps = [
+        srv1.submit(DecodeRequest(
+            prompt=p, max_new_tokens=want, sample=sample, seed=500 + i,
+            session_id=f"user{i}",
+        ))
+        for i, p in enumerate(prompts)
+    ]
+    plan = inject.FaultPlan().preempt_at_chunk(2)
+    with inject.inject(plan):
+        rc = srv1.serve()
+    assert rc == 0 and srv1.health.state is Health.DEAD
+    for p in ps:
+        assert p.result is not None and p.result.status == "suspended"
+        assert 0 < p.result.new_tokens < want, "must suspend MID-stream"
+    srv2 = Server(model, params, cfg)
+    conts = [
+        srv2.submit(DecodeRequest(
+            prompt=np.zeros((1, 0), np.int32),
+            max_new_tokens=want - ps[i].result.new_tokens,
+            sample=sample, seed=0, session_id=f"user{i}",
+        ))
+        for i in range(2)
+    ]
+    assert srv2.serve(drain_when_idle=True) == 0
+    for i in range(2):
+        assert conts[i].result.status == "ok", i
+        total = np.concatenate(
+            [ps[i].result.tokens, conts[i].result.tokens], axis=1
+        )
+        np.testing.assert_array_equal(total, refs[i], err_msg=f"session {i}")
+    srv2.close()
+
+
+def test_spec_server_resumes_plain_server_session_bitwise(mp, tmp_path):
+    """Cross-mode portability: a conversation suspended by a PLAIN
+    server resumes bitwise on a SPECULATING server — the snapshot is
+    the same O(1) carry and the speculative walk is the plain walk."""
+    model, params = mp
+    want = 16
+    prompt = _prompts(1)[0]
+    ref = _solo_refs(mp, [prompt], want, GREEDY)[0]
+    plain_cfg = ServeConfig(chunk=4, slots=2, max_inflight=4,
+                            session_dir=str(tmp_path / "sess"))
+    srv1 = Server(model, params, plain_cfg)
+    p1 = srv1.submit(DecodeRequest(prompt=prompt, max_new_tokens=8,
+                                   sample=GREEDY, seed=500,
+                                   session_id="conv"))
+    assert srv1.serve(drain_when_idle=True) == 0
+    srv1.close()
+    assert p1.result.status == "ok"
+    srv2 = Server(model, params, _spec_cfg(
+        slots=2, session_dir=str(tmp_path / "sess")))
+    p2 = srv2.submit(DecodeRequest(
+        prompt=np.zeros((1, 0), np.int32), max_new_tokens=8,
+        sample=GREEDY, seed=0, session_id="conv",
+    ))
+    assert srv2.serve(drain_when_idle=True) == 0
+    srv2.close()
+    total = np.concatenate([p1.result.tokens, p2.result.tokens], axis=1)
+    np.testing.assert_array_equal(total, ref)
+
+
+# ---------------------------------------------------------------------------
+# per-qmode parity: speculation composes with quantized serving
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("qmode", ["int8", "int4"])
+def test_spec_qmode_parity_bitwise(mp, qmode):
+    """Speculative decode under quantized weights: tokens bitwise the
+    QUANTIZED solo scan's (quantization changes the numbers, the verify
+    piece still replays the quantized walk's op sequence exactly).
+    Two same-length prompts keep the quant solo reference at ONE
+    compile per mode (the quick-tier budget; the staggered-admission
+    sweep is the fp32 parity matrix's job)."""
+    model, params = mp
+    qmodel, qparams = quantize_for_decode(model, params, mode=qmode)
+    prompts = [_prompts(1)[0], _prompts(6)[5]]  # both length 3
+    refs = [
+        np.asarray(generate(qmodel, qparams, p, 8, GREEDY,
+                            rng=jax.random.PRNGKey(500 + i)))
+        for i, p in enumerate(prompts)
+    ]
+    srv = Server(model, params, _spec_cfg(qmode=qmode, max_inflight=4))
+    ps = [
+        srv.submit(DecodeRequest(prompt=p, max_new_tokens=8, sample=GREEDY,
+                                 seed=500 + i))
+        for i, p in enumerate(prompts)
+    ]
+    assert srv.serve(drain_when_idle=True) == 0
+    for i, (p, ref) in enumerate(zip(ps, refs)):
+        assert p.result is not None and p.result.status == "ok", i
+        np.testing.assert_array_equal(p.result.tokens, ref,
+                                      err_msg=f"request {i} [{qmode}]")
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# adaptive depth floor
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_floor_scripted_adversarial_stream(mp):
+    """The floor logic against a scripted adversarial acceptance stream:
+    a slot opening strong then collapsing must floor exactly when its
+    EWMA crosses spec_min_accept (never on the first round), emit the
+    spec_floor event, and stay floored for the rest of its residency."""
+    model, params = mp
+    events = []
+    eng = SlotEngine(model, params, slots=2, chunk=4, spec_depth=DEPTH,
+                     spec_min_accept=0.3,
+                     on_event=lambda k, f: events.append((k, f)))
+    eng.admit(DecodeRequest(prompt=_prompts(1)[0], max_new_tokens=32,
+                            sample=GREEDY, seed=0), tag=0)
+    # scripted stream: perfect, perfect, then an adversarial collapse
+    ewmas = []
+    for accepted in (DEPTH, DEPTH, 0, 0, 0):
+        eng._update_spec_accept(0, accepted)
+        ewmas.append(eng._accept_ewma[0])
+    # EWMA walk (0.5/0.5): 1.0, 1.0, 0.5, 0.25 -> floor fires there
+    assert ewmas[:4] == [1.0, 1.0, 0.5, 0.25]
+    floors = [f for k, f in events if k == "spec_floor"]
+    assert len(floors) == 1 and floors[0]["slot"] == 0
+    assert floors[0]["rounds"] == 4
+    assert not eng._spec_on_np[0], "slot must ride plain afterwards"
+    # an immediate bad FIRST round on a fresh occupant must NOT floor
+    eng2 = SlotEngine(model, params, slots=1, chunk=4, spec_depth=DEPTH,
+                      spec_min_accept=0.3)
+    eng2.admit(DecodeRequest(prompt=_prompts(1)[0], max_new_tokens=32,
+                             sample=GREEDY, seed=0), tag=0)
+    eng2._update_spec_accept(0, 0)
+    assert eng2._spec_on_np[0], "one unlucky round is not a trend"
+
+
+def test_floored_slot_rides_plain_and_stays_bitwise(mp):
+    """End-to-end floor behaviour on the real (random-weight, so
+    low-acceptance) model: with a high floor every slot falls back to
+    plain decode, output stays bitwise, and post-floor boundaries run
+    the plain chunk program (full chunk per boundary)."""
+    model, params = mp
+    prompts = _prompts(2)
+    refs = _solo_refs(mp, prompts, 12, GREEDY)
+    events = []
+    eng = SlotEngine(model, params, slots=2, chunk=4, spec_depth=DEPTH,
+                     spec_min_accept=1.01,  # adversarial: nothing passes
+                     on_event=lambda k, f: events.append((k, f)))
+    for i, p in enumerate(prompts):
+        eng.admit(DecodeRequest(prompt=p, max_new_tokens=12, sample=GREEDY,
+                                seed=500 + i), tag=i)
+    done = {}
+    while eng.busy:
+        done.update(dict(eng.step()))
+    for i in range(2):
+        assert done[i].status == "ok"
+        np.testing.assert_array_equal(done[i].tokens, refs[i])
+    assert sum(1 for k, _ in events if k == "spec_floor") == 2
+    # once every resident slot is floored the engine emits no more
+    # spec_round events — the plain program owns those boundaries (the
+    # flooring round itself still reports, nothing after it)
+    kinds = [k for k, _ in events]
+    last_floor = max(i for i, k in enumerate(kinds) if k == "spec_floor")
+    assert kinds[last_floor + 1:].count("spec_round") <= 1
+    assert "spec_round" in kinds
+
+
+# ---------------------------------------------------------------------------
+# construction guards + bookkeeping surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_spec_depth_guards(mp):
+    model, params = mp
+    with pytest.raises(ValueError, match="window"):
+        SlotEngine(model, params, slots=2, spec_depth=CFG.window)
+    no_linear = dataclasses.replace(
+        CFG, layer_types=("softmax", "swa", "swa"))
+    m2 = TransformerLM(no_linear)
+    with pytest.raises(ValueError, match="linear"):
+        SlotEngine(m2, params, slots=2, spec_depth=2)
+    moe = dataclasses.replace(CFG, layer_types=None, n_experts=2,
+                              moe_period=2)
+    m3 = TransformerLM(moe)
+    with pytest.raises(ValueError, match="MoE|dense"):
+        SlotEngine(m3, params, slots=2, spec_depth=2)
+
+
+def test_spec_info_and_statusz_section(mp):
+    """/statusz speculation section: per-slot depth, enable bit, rolling
+    acceptance; totals from the registry counters."""
+    model, params = mp
+    srv = Server(model, params, _spec_cfg(slots=2))
+    p = srv.submit(DecodeRequest(prompt=_prompts(1)[0], max_new_tokens=8,
+                                 sample=GREEDY, seed=0))
+    assert srv.serve(drain_when_idle=True) == 0
+    assert p.result.status == "ok"
+    doc = srv._statusz()
+    assert doc["speculation"]["depth"] == DEPTH
+    assert doc["speculation"]["accepted_total"] + doc["speculation"][
+        "rejected_total"] > 0
+    flat = srv.metrics.counters_flat()
+    assert flat.get("spec_accepted_total", 0) == doc["speculation"][
+        "accepted_total"]
+    # the per-turn acceptance histogram saw exactly one observation
+    hists = [h for h in srv.metrics.snapshot()["histograms"]
+             if h["name"] == "spec_accept_rate"]
+    assert len(hists) == 1 and hists[0]["count"] == 1
+    srv.close()
