@@ -362,6 +362,67 @@ def _snap_decode_batched_spec_tiny() -> Tuple[Any, Any, Dict[str, Any]]:
     return jaxpr, lowered, meta
 
 
+def _snap_decode_batched_tp(tp: int) -> Tuple[Any, Any, Dict[str, Any]]:
+    """The slot-multiplexed batched decode chunk compiled under a tp=N
+    mesh (ISSUE 14, SlotEngine(mesh=...)): params sharded by the training
+    rules, state head-sharded, per-slot vectors replicated. Four pins:
+
+    - ``hlo_collectives``: exactly the Megatron contract — TWO
+      all-reduces per block per decode step (wo + down), nothing else
+      (the head-sharded state and the qkv/gate/up output shards
+      communicate nothing). A third collective appearing here is a
+      leaked per-token cost no CPU parity test would catch.
+    - ``scan_carry_bytes_per_device``: the head-sharded state divides by
+      tp while only the few per-slot bookkeeping vectors replicate —
+      tests/test_analysis.py asserts it against the unsharded
+      ``decode_batched_tiny`` carry.
+    - the collectives live INSIDE the decode scan's while-loop body
+      (they depend on each step's activations — there is nothing to
+      hoist), so program-level counts ARE per-step counts.
+    - dtype_counts/op_histogram: the partitioned program's shape.
+
+    The trace fixtures are shared with the Tier C budget audit
+    (spmd_audit.tp_decode_pieces) so budget and snapshot can never drift
+    onto different programs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orion_tpu.analysis.spmd_audit import tp_decode_pieces
+    from orion_tpu.generate import SampleConfig, _decode_batched_chunk_jit
+    from orion_tpu.parallel.decode import bytes_per_device
+
+    slots, chunk = 8, 8
+    model, params, carry, rngs, vec, shardings = tp_decode_pieces(
+        tp=tp, slots=slots
+    )
+    (p_abs, p_shd), (st_abs, st_shd), _mesh = shardings
+    args = (model, params, carry, rngs, vec(jnp.bool_), chunk, SampleConfig())
+    jaxpr = jax.make_jaxpr(
+        _decode_batched_chunk_jit, static_argnums=(0, 5, 6)
+    )(*args)
+    lowered = _decode_batched_chunk_jit.lower(*args)
+    # per-device carry bytes from the PLACEMENT (shape arithmetic, no
+    # compile): sharded state / tp + the replicated per-slot vectors
+    state_dev = bytes_per_device(st_abs, st_shd)
+    vec_bytes = slots * (3 * np.dtype(np.int32).itemsize + 1)
+    meta = {
+        "slots": slots, "chunk": chunk, "mesh": {"tp": tp},
+        "param_bytes_per_device": bytes_per_device(p_abs, p_shd),
+        "scan_carry_bytes_per_device": state_dev + vec_bytes,
+        "donated_args": 0,
+    }
+    return jaxpr, lowered, meta
+
+
+def _snap_decode_batched_tp2():
+    return _snap_decode_batched_tp(2)
+
+
+def _snap_decode_batched_tp4():
+    return _snap_decode_batched_tp(4)
+
+
 def _snap_decode_batched_int8():
     return _snap_decode_batched_quant("int8")
 
@@ -380,6 +441,8 @@ SNAPSHOT_TARGETS: Dict[str, Callable[[], Tuple[Any, Any, Dict[str, Any]]]] = {
     "decode_batched_spec_tiny": _snap_decode_batched_spec_tiny,
     "decode_batched_int8": _snap_decode_batched_int8,
     "decode_batched_int4": _snap_decode_batched_int4,
+    "decode_batched_tp2": _snap_decode_batched_tp2,
+    "decode_batched_tp4": _snap_decode_batched_tp4,
 }
 
 

@@ -314,6 +314,95 @@ def trace_swa_halo():
     )(q, k, v)
 
 
+def tp_decode_pieces(tp: int = 2, slots: int = 8):
+    """Shared fixtures for the tp decode traces AND the golden snapshots
+    (snapshots._snap_decode_batched_tp): tiny model, tp=N mesh over the
+    first N virtual devices, tp-sharded abstract params (the training
+    rules), head-sharded abstract state, replicated per-slot vectors —
+    budget audit and snapshot must always describe the SAME program, the
+    one ``SlotEngine(mesh=...)`` serves. Returns
+    (model, params, carry, rngs, vec, shardings) where ``shardings`` is
+    the (param, state) NamedSharding pair for per-device accounting."""
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM, init_decode_state
+    from orion_tpu.parallel.decode import (
+        decode_param_shardings,
+        decode_state_shardings,
+        serving_mesh,
+    )
+
+    cfg = get_config("tiny")
+    model = TransformerLM(cfg)
+    mesh = serving_mesh(tp)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    sds = lambda l, s: jax.ShapeDtypeStruct(  # noqa: E731
+        l.shape, l.dtype, sharding=s
+    )
+    prompt = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0), prompt)
+    p_shd = decode_param_shardings(abstract, mesh)
+    params = jax.tree.map(sds, abstract, p_shd)
+    states_abs = jax.eval_shape(lambda: init_decode_state(cfg, slots))
+    st_shd = decode_state_shardings(states_abs, mesh)
+    states = jax.tree.map(sds, states_abs, st_shd)
+    vec = lambda dt: jax.ShapeDtypeStruct(  # noqa: E731
+        (slots,), dt, sharding=rep
+    )
+    carry = (
+        vec(jnp.int32), states, vec(jnp.int32), vec(jnp.int32),
+        vec(jnp.bool_),
+    )
+    rngs = jax.ShapeDtypeStruct((slots, 2), jnp.uint32, sharding=rep)
+    shardings = ((abstract, p_shd), (states_abs, st_shd), mesh)
+    return model, params, carry, rngs, vec, shardings
+
+
+def trace_decode_batched_tp():
+    """The tp=2 slot-multiplexed decode chunk: like the GSPMD train step,
+    the traced jaxpr must be collective-FREE (jit inserts the two
+    per-block all-reduces from the shardings after tracing) — an
+    explicit collective inside the decode scan would run per token."""
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import SampleConfig, _decode_batched_chunk_jit
+
+    model, params, carry, rngs, vec, _ = tp_decode_pieces()
+    return jax.make_jaxpr(
+        _decode_batched_chunk_jit, static_argnums=(0, 5, 6)
+    )(model, params, carry, rngs, vec(jnp.bool_), 8, SampleConfig())
+
+
+def trace_decode_batched_prefill_tp():
+    """The tp=2 unified in-scan prefill + decode program: staging and
+    prompt pieces must stay jaxpr-collective-free too."""
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import (
+        SampleConfig,
+        _decode_batched_prefill_chunk_jit,
+    )
+
+    model, params, carry, rngs, vec, shardings = tp_decode_pieces()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    pbuf = jax.ShapeDtypeStruct(
+        (8, 16), jnp.int32, sharding=NamedSharding(shardings[2], P())
+    )
+    return jax.make_jaxpr(
+        _decode_batched_prefill_chunk_jit, static_argnums=(0, 8, 9, 10)
+    )(
+        model, params, carry, rngs, vec(jnp.bool_), pbuf, vec(jnp.int32),
+        vec(jnp.int32), 8, 16, SampleConfig(),
+    )
+
+
 def trace_pipeline_lm_step():
     """The pp=2 trainer step (fwd+bwd): stage-rotation ppermutes inside the
     GPipe scan plus the loop-invariant psums its transposes generate."""
@@ -345,6 +434,8 @@ SPMD_TARGETS = {
     "ring_attention_striped": trace_ring_striped,
     "swa_halo_attention": trace_swa_halo,
     "pipeline_lm_step": trace_pipeline_lm_step,
+    "decode_batched_tp": trace_decode_batched_tp,
+    "decode_batched_prefill_tp": trace_decode_batched_prefill_tp,
 }
 
 

@@ -153,6 +153,180 @@ def plan(
     return report
 
 
+def decode_plan(
+    model_cfg,
+    slots: int = 8,
+    chunk: int = 16,
+    prefill_buckets=(),
+    prefill_chunk: int = 0,
+    qmode: str = "off",
+    tp: int = 0,
+    spec_depth: int = 0,
+    compile_step: bool = True,
+) -> Dict[str, Any]:
+    """The SERVING-side inventory ``plan`` never had (ISSUE 14): every
+    decode/prefill executable a replica of this shape compiles, keyed
+    exactly like the jit caches — (slots, chunk, bucket, qmode, tp) —
+    lowered (and optionally compiled) against abstract sharded state.
+    This is the complete program list ROADMAP item 4's warm-start work
+    needs to persist: a respawned replica serving these shapes runs
+    precisely these executables, nothing else (the engine's
+    one-compile-per-key contract is cache-stat-asserted in tests).
+
+    Per program: the GSPMD collectives (for tp plans: the two
+    per-block all-reduces per decode step — evidence the mesh engaged)
+    and the compiler's code size, the artifact a warm-start cache would
+    key and store."""
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import (
+        SampleConfig,
+        _decode_batched_chunk_jit,
+        _decode_batched_prefill_chunk_jit,
+        _decode_batched_spec_round_jit,
+        _prefill_carry_bucketed_jit,
+    )
+    from orion_tpu.models.transformer import TransformerLM, init_decode_state
+
+    tp = max(int(tp), 1)
+    model = TransformerLM(model_cfg, quant=qmode if qmode != "off" else "")
+    mesh = None
+    if tp > 1:
+        from orion_tpu.parallel.decode import serving_mesh
+
+        mesh = serving_mesh(tp)
+
+    prompt = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0), prompt)
+    states = jax.eval_shape(lambda: init_decode_state(model_cfg, slots))
+    if mesh is not None:
+        from orion_tpu.parallel.decode import (
+            decode_param_shardings,
+            decode_state_shardings,
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sds = lambda l, s: jax.ShapeDtypeStruct(  # noqa: E731
+            l.shape, l.dtype, sharding=s
+        )
+        params = jax.tree.map(
+            sds, abstract, decode_param_shardings(abstract, mesh)
+        )
+        states = jax.tree.map(
+            sds, states, decode_state_shardings(states, mesh)
+        )
+        rep = NamedSharding(mesh, P())
+        shaped = lambda shape, dt: jax.ShapeDtypeStruct(  # noqa: E731
+            shape, dt, sharding=rep
+        )
+    else:
+        params = abstract
+        shaped = jax.ShapeDtypeStruct
+    vec = lambda dt: shaped((slots,), dt)  # noqa: E731
+    carry = (
+        vec(jnp.int32), states, vec(jnp.int32), vec(jnp.int32),
+        vec(jnp.bool_),
+    )
+    rngs = shaped((slots, 2), jnp.uint32)
+    active = vec(jnp.bool_)
+    sample = SampleConfig()
+    base_key = {"slots": slots, "chunk": chunk, "qmode": qmode, "tp": tp}
+
+    programs = []
+
+    def add(kind: str, key: Dict[str, Any], lower):
+        entry: Dict[str, Any] = {"kind": kind, **key}
+        try:
+            lowered = lower()
+            entry["lowered"] = True
+            if compile_step:
+                compiled = lowered.compile()
+                entry["compiled"] = True
+                try:
+                    entry["collectives"] = _collective_counts(
+                        compiled.as_text()
+                    )
+                except Exception as e:
+                    entry["collectives_error"] = (
+                        f"{type(e).__name__}: {e}"[:120]
+                    )
+                try:
+                    ma = compiled.memory_analysis()
+                    if ma is not None:
+                        v = getattr(ma, "generated_code_size_in_bytes", None)
+                        if v is not None:
+                            entry["generated_code_size_in_bytes"] = int(v)
+                except Exception:
+                    pass
+        except Exception as e:  # surface, never crash the inventory
+            entry["error"] = f"{type(e).__name__}: {e}"[:200]
+        programs.append(entry)
+
+    add("decode_batched", dict(base_key), lambda: (
+        _decode_batched_chunk_jit.lower(
+            model, params, carry, rngs, active, int(chunk), sample
+        )
+    ))
+    # the engine's in-scan piece boundaries align to the linear-attention
+    # chunk (SlotEngine rounds the knob up; batching.py chunk_align) — the
+    # inventory must list the pchunk the replica actually compiles, and
+    # prefill_chunk=0 means host-side prefill: no unified program exists
+    pchunk = 0
+    if int(prefill_chunk) > 0:
+        from orion_tpu.ops.dispatch import resolve, resolve_chunk
+
+        align = resolve_chunk(
+            model_cfg.chunk, model_cfg.max_seq_len, resolve(model_cfg.backend)
+        )
+        pchunk = -(-int(prefill_chunk) // align) * align
+    for bucket in prefill_buckets or ():
+        pbuf = shaped((slots, int(bucket)), jnp.int32)
+        if pchunk:
+            add(
+                "unified_prefill",
+                dict(base_key, bucket=int(bucket), prefill_chunk=pchunk),
+                lambda pbuf=pbuf, pchunk=pchunk: (
+                    _decode_batched_prefill_chunk_jit.lower(
+                        model, params, carry, rngs, active, pbuf,
+                        vec(jnp.int32), vec(jnp.int32), int(chunk), pchunk,
+                        sample,
+                    )
+                ),
+            )
+        # the host-side bucketed prefill (admission with prefill_chunk=0,
+        # the ladder's re-prefill rung, prefix publishes): batch 1
+        add(
+            "prefill_bucketed",
+            {"bucket": int(bucket), "qmode": qmode, "tp": tp},
+            lambda bucket=bucket: _prefill_carry_bucketed_jit.lower(
+                model, params, shaped((1, int(bucket)), jnp.int32), sample,
+                shaped((2,), jnp.uint32), shaped((), jnp.int32),
+                shaped((1,), jnp.bool_), shaped((), jnp.int32),
+            ),
+        )
+    if spec_depth:
+        add(
+            "spec_round",
+            {"slots": slots, "spec_depth": int(spec_depth),
+             "qmode": qmode, "tp": tp},
+            lambda: _decode_batched_spec_round_jit.lower(
+                model, params, carry, rngs, active, vec(jnp.bool_),
+                int(spec_depth), sample,
+            ),
+        )
+    return {
+        "config": model_cfg.name,
+        "qmode": qmode,
+        "tp": tp,
+        "slots": slots,
+        "chunk": chunk,
+        "prefill_buckets": list(prefill_buckets or ()),
+        "n_programs": len(programs),
+        "programs": programs,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("orion_tpu.aot")
     p.add_argument("--config", default="hybrid_7b")
@@ -176,6 +350,21 @@ def main(argv=None) -> int:
                         "--force-cpu-devices)")
     p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                    help="ModelConfig override, e.g. --set backend=pallas")
+    # -- serving-side inventory (ISSUE 14): the decode/prefill
+    # executables a replica of this shape compiles, per
+    # (slots, chunk, bucket, qmode, tp) — the warm-start program list
+    p.add_argument("--decode", action="store_true",
+                   help="plan the batched decode/prefill executables "
+                        "instead of the train step (--slots/--chunk/"
+                        "--prefill-chunk/--qmode/--spec-depth; --tp is "
+                        "the serving mesh footprint)")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--chunk", type=int, default=16)
+    p.add_argument("--prefill-chunk", type=int, default=64)
+    p.add_argument("--prefill-buckets", default="pow2",
+                   help="bucket spec as in serving (pow2 | a,b,c | off)")
+    p.add_argument("--qmode", default="off", choices=["off", "int8", "int4"])
+    p.add_argument("--spec-depth", type=int, default=0)
     args = p.parse_args(argv)
 
     if args.topology:
@@ -189,10 +378,10 @@ def main(argv=None) -> int:
     elif args.force_cpu_devices:
         import jax
 
+        from orion_tpu.utils.devices import ensure_virtual_devices
+
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update(
-            "jax_num_cpu_devices", args.force_cpu_devices
-        )
+        ensure_virtual_devices(args.force_cpu_devices)
 
     from orion_tpu.models.configs import get_config
     from orion_tpu.parallel.mesh import MeshConfig
@@ -203,6 +392,24 @@ def main(argv=None) -> int:
         from orion_tpu.utils.config import apply_overrides, parse_set_overrides
 
         model = apply_overrides(model, parse_set_overrides(args.set))
+    if args.decode:
+        from orion_tpu.serving.batching import parse_buckets
+
+        report = decode_plan(
+            model,
+            slots=args.slots,
+            chunk=args.chunk,
+            prefill_buckets=parse_buckets(
+                args.prefill_buckets, model.max_seq_len
+            ),
+            prefill_chunk=args.prefill_chunk,
+            qmode=args.qmode,
+            tp=args.tp,
+            spec_depth=args.spec_depth,
+            compile_step=not args.lower_only,
+        )
+        print(json.dumps(report))
+        return 0
     seq_len = args.seq_len or model.max_seq_len
     if seq_len > model.max_seq_len:
         model = dataclasses.replace(model, max_seq_len=seq_len)

@@ -61,6 +61,13 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--prefill-buckets", default="pow2")
     p.add_argument("--replica-max-inflight", type=int, default=8,
                    help="per-replica admission queue bound")
+    p.add_argument("--tp", type=int, default=0,
+                   help="device-mesh footprint per replica (ISSUE 14): "
+                        "each replica shards its batched decode over a "
+                        "tp-device mesh (a CPU child provisions its own "
+                        "virtual devices). Tokens are bitwise the "
+                        "unsharded fleet's; sessions stay portable "
+                        "across footprints. 0/1 = unsharded")
     p.add_argument("--qmode", choices=["off", "int8", "int4"],
                    default="off",
                    help="weight-streamed quantized serving inside EVERY "
@@ -184,6 +191,7 @@ def _spec_from_args(args) -> ReplicaSpec:
         overrides=overrides or None,
         ckpt_dir=args.ckpt_dir,
         serve=serve,
+        tp=max(args.tp, 0),
     )
 
 
@@ -208,6 +216,13 @@ def main(argv=None) -> int:
     if args.session_id and not args.session_dir:
         print("--session-id requires --session-dir", file=sys.stderr)
         return 2
+    if args.local and args.tp and args.tp > 1:
+        # --local replicas share THIS process's device client: provision
+        # the virtual CPU devices here, before anything touches jax
+        # (process replicas provision their own in _child_main)
+        from orion_tpu.utils.devices import ensure_virtual_devices
+
+        ensure_virtual_devices(args.tp)
     spec = _spec_from_args(args)
 
     # parent-side telemetry: the router's root spans and the supervisor/

@@ -89,7 +89,17 @@ class ReplicaSpec:
     whole machine and replication measures as noise. One distinct core
     per replica is the production deployment shape and what ``bench.py
     --fleet`` uses so replicas=2 measures real process parallelism (see
-    :func:`pin_compute_pool`)."""
+    :func:`pin_compute_pool`).
+
+    ``tp``: the replica's device-mesh FOOTPRINT (ISSUE 14) — 0/1 serves
+    unsharded, N shards the batched decode over an N-device tp mesh
+    (``ServeConfig.tp``). A fleet may mix footprints behind one router:
+    tokens are pinned bitwise across footprints and the session store
+    holds the logical (footprint-free) carry row, so a conversation
+    suspended on a tp=2 replica resumes on a tp=4 or unsharded sibling
+    as a host-side reshape. A CPU child provisions
+    ``xla_force_host_platform_device_count=tp`` for itself before its
+    backend initializes (``_child_main``)."""
 
     config: str = "tiny"
     overrides: Optional[Dict[str, Any]] = None  # ModelConfig field -> value
@@ -98,6 +108,7 @@ class ReplicaSpec:
     serve: Optional[Dict[str, Any]] = None  # ServeConfig kwargs
     faults: Optional[List[Dict[str, Any]]] = None
     compute_cpus: Optional[List[int]] = None
+    tp: int = 0  # device-mesh footprint (0/1 = unsharded)
     # jax.config.update entries applied in the child before building the
     # model — a replica must decode under the SAME numerics flags as its
     # siblings (and as any in-parent reference), or "which replica served
@@ -185,15 +196,33 @@ def build_model(spec: ReplicaSpec):
     return model, params, f"{spec.config}:ov={ov}:seed={spec.init_seed}"
 
 
+def replica_footprint(spec: ReplicaSpec) -> int:
+    """The replica's EFFECTIVE device-mesh footprint: ``spec.tp`` when
+    set, else a ``tp`` riding in the serve dict (``ServeConfig.tp`` is
+    public — a footprint expressed only there must still provision its
+    devices in ``_child_main``, or the child's Server dies at
+    construction and the supervisor respawns into the same crash)."""
+    if spec.tp and spec.tp > 1:
+        return int(spec.tp)
+    return int((spec.serve or {}).get("tp", 0) or 0)
+
+
 def serve_config(spec: ReplicaSpec, params_id: Optional[str] = None):
     """ServeConfig from the spec; ``params_id`` (from
     :func:`build_model`) fills the prefix-addressing identity unless the
-    spec pinned one explicitly."""
+    spec pinned one explicitly, and the spec's mesh footprint
+    (:func:`replica_footprint` — ``spec.tp`` winning over the serve
+    dict) is stamped onto the config: the footprint is a placement
+    property of the REPLICA, not a serving knob two sources may
+    disagree on."""
     from orion_tpu.serving.server import ServeConfig
 
     cfg = ServeConfig(**(spec.serve or {}))
     if params_id and not cfg.params_id:
         cfg = dataclasses.replace(cfg, params_id=params_id)
+    fp = replica_footprint(spec)
+    if fp > 1:
+        cfg = dataclasses.replace(cfg, tp=fp)
     return cfg
 
 
@@ -828,6 +857,14 @@ def _child_main() -> int:
     spec = ReplicaSpec.from_json(sys.stdin.readline())
     for flag, value in (spec.jax_flags or {}).items():
         jax.config.update(flag, value)
+    # the effective footprint (spec.tp OR a tp riding the serve dict)
+    # needs that many devices in THIS process — provision before anything
+    # touches a device (nothing above did), or the child's Server dies at
+    # serving_mesh construction and the supervisor respawns into the
+    # same crash
+    from orion_tpu.utils.devices import ensure_virtual_devices
+
+    ensure_virtual_devices(replica_footprint(spec))
     if spec.compute_cpus:
         pin_compute_pool(spec.compute_cpus)
 

@@ -304,6 +304,16 @@ def decode_chunk(
 # — every op is batch-row-independent, so each slot's walk is
 # bitwise-identical to serving that request alone (the acceptance property
 # tests/test_batching.py pins for slot counts {2, 4, 8}).
+#
+# Tensor parallelism (ISSUE 14) adds NO program variants here: the same
+# jit wrappers are mesh-aware through their INPUTS. When the engine
+# places params by the training sharding rules and the state head-sharded
+# (parallel/decode.py), the jit cache keys on those shardings and GSPMD
+# partitions each program — two all-reduces per block per decode step
+# (wo/down psum-at-output; golden decode_batched_tp{2,4}.json), zero
+# state collectives. Tokens stay bitwise the unsharded walk's
+# (tests/test_tp_serving.py); anything per-slot stays replicated so the
+# admission/eviction row ops below work unchanged on any footprint.
 
 
 def _sample_rows(logits: Array, keys: Array, cfg: SampleConfig) -> Array:

@@ -149,6 +149,26 @@ class Histogram:
                 "count": got["count"],
             }
 
+    def cell_total(self) -> Optional[dict]:
+        """Every label cell summed into one (same shape as :meth:`cell`)
+        — the label-agnostic read for consumers that window the WHOLE
+        instrument (the SLO engine's latency readers: chunk_ms cells
+        carry a ``tp`` footprint label since ISSUE 14, and a windowed
+        p99 over 'all chunks this server ran' must not vanish because
+        the cells grew a label). None when nothing observed yet."""
+        with self._registry._lock:
+            cells = self._registry._hists[self.name]
+            if not cells:
+                return None
+            counts = [0] * len(self.buckets)
+            total, n = 0, 0
+            for got in cells.values():
+                for i, c in enumerate(got["counts"]):
+                    counts[i] += c
+                total += got["sum"]
+                n += got["count"]
+            return {"counts": counts, "sum": total, "count": n}
+
 
 class MetricsRegistry:
     """The spine's instrument store. ``lock``: an externally-owned RLock

@@ -298,7 +298,10 @@ def registry_readers(registry) -> Dict[str, Tuple]:
         h = registry.histogram(hist_name)
 
         def read_counts(h=h):
-            cell = h.cell()
+            # label-agnostic: chunk_ms cells carry the tp footprint
+            # label (ISSUE 14) — the objective windows the instrument,
+            # not one cell
+            cell = h.cell_total()
             if cell is None:
                 return (0,) * len(h.buckets)
             return tuple(cell["counts"])
@@ -495,10 +498,24 @@ def _snapshot_counters(snap: dict) -> Dict[str, object]:
 
 
 def _snapshot_histogram(snap: dict, name: str) -> Optional[dict]:
+    """All of ``name``'s label cells summed (the snapshot-side twin of
+    ``Histogram.cell_total``): chunk_ms cells carry a ``tp`` label since
+    ISSUE 14, and a lifetime check over a dump must see the same totals
+    the live readers window."""
+    out: Optional[dict] = None
     for row in snap.get("histograms", ()):
-        if row["name"] == name and not row.get("labels"):
-            return row
-    return None
+        if row["name"] != name:
+            continue
+        if out is None:
+            out = {"name": name, "buckets": row.get("buckets"),
+                   "counts": list(row["counts"]), "sum": row["sum"],
+                   "count": row["count"]}
+        else:
+            for i, c in enumerate(row["counts"]):
+                out["counts"][i] += c
+            out["sum"] += row["sum"]
+            out["count"] += row["count"]
+    return out
 
 
 def check_snapshot(

@@ -148,6 +148,34 @@ BUDGETS: Dict[str, StepBudget] = {
         ),
         note="traced as the tiny-model pp=2 trainer step (fwd+bwd)",
     ),
+    # -- tensor-parallel batched decode (ISSUE 14) ------------------------
+    # generate._decode_batched_chunk_jit traced with tp=2-sharded params
+    # and head-sharded state. Like the GSPMD train step, the budget is
+    # EMPTY: every all-reduce (two per block per step — wo + down, the
+    # Megatron contract) is inserted by jit from the shardings AFTER
+    # tracing, so the jaxpr must contain no explicit collective at all.
+    # A manual psum/all_gather leaking into the decode scan body would
+    # run once per TOKEN — the classic silent serving slowdown no CPU
+    # parity test can see; here it is an unbudgeted-collective (and
+    # in-scan) tier-1 finding. The counts GSPMD actually inserts are
+    # pinned one layer down by golden decode_batched_tp{2,4}.json.
+    "decode_batched_tp": StepBudget(
+        step="decode_batched_tp",
+        allows=(),
+        note="GSPMD-only: the per-step all-reduces come from the "
+             "shardings; any explicit collective in the decode scan is "
+             "a finding",
+    ),
+    # The unified in-scan prefill+decode program under the same tp=2
+    # placement: admission staging and the prompt pieces must stay as
+    # communication-free in the jaxpr as pure decode (prefill pieces are
+    # per-head local too; GSPMD inserts the same wo/down all-reduces).
+    "decode_batched_prefill_tp": StepBudget(
+        step="decode_batched_prefill_tp",
+        allows=(),
+        note="GSPMD-only, same contract as decode_batched_tp for the "
+             "unified prefill+decode program",
+    ),
 }
 
 

@@ -92,6 +92,14 @@ def build_argparser() -> argparse.ArgumentParser:
                         "falls back to plain decode for the rest of its "
                         "residency instead of paying a losing draft "
                         "(0 = never fall back)")
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel decode over a tp-device mesh "
+                        "(ISSUE 14): weights shard by the training rules "
+                        "(two all-reduces per block per step), the O(1) "
+                        "state shards on heads, tokens stay bitwise the "
+                        "unsharded server's. 0/1 = unsharded. The process "
+                        "must expose >= tp devices (on CPU: XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N)")
     p.add_argument("--qmode", choices=["off", "int8", "int4"],
                    default="off",
                    help="weight-streamed quantized serving: the loaded "
@@ -188,6 +196,12 @@ def main(argv=None) -> int:
 
     enable_compile_cache()
     args = build_argparser().parse_args(argv)
+    if args.tp and args.tp > 1:
+        # a CPU host needs tp virtual devices; nothing above touched a
+        # device, so the flag still takes (real TPU hosts expose chips)
+        from orion_tpu.utils.devices import ensure_virtual_devices
+
+        ensure_virtual_devices(args.tp)
     # ONE guard spans the whole lifecycle — startup, submission, every
     # serve wave — so SIGTERM during model load or between waves maps to
     # a graceful drain (exit 0) too, not just mid-decode; Server.serve
@@ -293,8 +307,17 @@ def _run(args, guard) -> int:
             metrics_interval_s=args.metrics_interval_s,
             trace_path=args.trace_path, flight_dir=args.flight_dir,
             metrics_port=args.metrics_port, slo=slo_cfg,
+            tp=args.tp,
         ),
     )
+    if server.mesh_info is not None:
+        print(
+            f"tp mesh: tp={server.mesh_info['tp']} "
+            f"param_bytes/device={server.mesh_info['param_bytes_per_device']} "
+            f"carry_bytes/device={server.mesh_info['carry_bytes_per_device']} "
+            f"budget_ok={server.mesh_info.get('budget_ok')}",
+            file=sys.stderr,
+        )
     if server.http_port is not None:
         print(f"live telemetry: http://127.0.0.1:{server.http_port}"
               "/metrics | /healthz | /statusz | /slo", file=sys.stderr)

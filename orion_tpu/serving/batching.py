@@ -65,7 +65,10 @@ from its scheduler loop and maps finished slots back onto Pendings.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import functools
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -94,6 +97,31 @@ from orion_tpu.serving.session import DecodeRequest, DecodeResult
 from orion_tpu.serving.session_store import SessionState
 
 Array = jax.Array
+
+# XLA-CPU executes a multi-device program by rendezvousing one thread per
+# device at each collective. Two mesh engines in ONE process (LocalReplica
+# fleets over shared virtual devices) launching collective programs
+# concurrently can interleave their rendezvous — rank 0 joins replica A's
+# all-reduce while rank 1 joins replica B's — and deadlock. Every
+# program-launching entry point of a mesh-backed engine therefore
+# serializes on this process-wide lock (reentrant: entry points nest
+# through the ladder). Unsharded engines never touch it, and in the
+# production shape — one server per process (ProcessReplica children own
+# their devices) — it is simply uncontended.
+_TP_EXEC_LOCK = threading.RLock()
+
+
+def _serialized(method):
+    """Hold the engine's exec guard (the process-wide _TP_EXEC_LOCK for
+    mesh engines, a nullcontext otherwise) across a program-launching
+    entry point."""
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        with self._exec_lock:
+            return method(self, *args, **kwargs)
+
+    return wrapper
 
 
 @jax.jit
@@ -314,11 +342,32 @@ class SlotEngine:
         prefix_store: Optional[Any] = None,
         spec_depth: int = 0,
         spec_min_accept: float = 0.0,
+        mesh: Optional[Any] = None,
     ):
         assert slots > 0, slots
         assert chunk > 0, chunk
         assert prompt_overflow in ("error", "clamp"), prompt_overflow
         self.model = model
+        # tensor-parallel serving (ISSUE 14): with a mesh, the params are
+        # placed by the training sharding rules (heads/hidden on tp,
+        # wo/down psum-at-output) and the decode state shards on the
+        # head dimension — the SAME four jit wrappers then run under
+        # GSPMD, which inserts the two per-block all-reduces per step
+        # (golden decode_batched_tp{2,4}). Emitted tokens are pinned
+        # BITWISE the unsharded engine's; the per-slot carry vectors
+        # stay replicated so admission, ladder snapshots, and session
+        # suspend/resume remain plain row operations on any footprint.
+        self.mesh = mesh
+        self.tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+        # see _TP_EXEC_LOCK: collective-program launches from co-resident
+        # mesh engines must not interleave their device rendezvous
+        self._exec_lock = (
+            _TP_EXEC_LOCK if mesh is not None else contextlib.nullcontext()
+        )
+        if mesh is not None:
+            from orion_tpu.parallel.decode import place_decode_params
+
+            params = place_decode_params(params, mesh)
         self.params = params
         self.slots = int(slots)
         self.chunk = int(chunk)
@@ -439,6 +488,16 @@ class SlotEngine:
         self._pfold = jnp.zeros((self.slots,), jnp.int32)
         self._pbuf: Optional[Array] = None
         self._done_np = np.ones((self.slots,), bool)
+        if mesh is not None:
+            from orion_tpu.parallel.decode import (
+                place_decode_carry,
+                place_replicated,
+            )
+
+            self._carry = place_decode_carry(self._carry, mesh)
+            self._rngs = place_replicated(self._rngs, mesh)
+            self._plen = place_replicated(self._plen, mesh)
+            self._pfold = place_replicated(self._pfold, mesh)
 
     def _emit(self, kind: str, **fields) -> None:
         if self._on_event is not None:
@@ -533,6 +592,7 @@ class SlotEngine:
         self._spec_on_np[free[0]] = True
         return free[0]
 
+    @_serialized
     def admit(
         self,
         request: DecodeRequest,
@@ -661,6 +721,13 @@ class SlotEngine:
                 self._pbuf = jnp.pad(
                     self._pbuf, ((0, 0), (0, b - width))
                 )
+            if self.mesh is not None:
+                # a freshly (re)allocated staging buffer lands on the
+                # default device; the unified program wants it replicated
+                # over the mesh like every other per-slot input
+                from orion_tpu.parallel.decode import place_replicated
+
+                self._pbuf = place_replicated(self._pbuf, self.mesh)
             width = b
         return jnp.pad(prompt, ((0, 0), (0, width - prompt.shape[1])))[0]
 
@@ -763,6 +830,7 @@ class SlotEngine:
         class admission beats for)."""
         return bool(self._pending_prefix)
 
+    @_serialized
     def publish_pending_prefixes(self) -> int:
         """Publish queued prefix snapshots: prefill the prefix solo (the
         bucketed host-prefill compile, one per bucket) and hand the
@@ -808,6 +876,7 @@ class SlotEngine:
                 )
         return done
 
+    @_serialized
     def resume(
         self,
         sess: SessionState,
@@ -875,6 +944,7 @@ class SlotEngine:
 
     # -- the chunk step -------------------------------------------------------
 
+    @_serialized
     def step(self) -> List[Tuple[Any, DecodeResult]]:
         """Advance every resident slot by one chunk (the scheduler calls
         this only when ``busy``). Returns (tag, DecodeResult) for every
@@ -1297,6 +1367,7 @@ class SlotEngine:
         )
         return result
 
+    @_serialized
     def suspend_sessions(self) -> List[Tuple[Any, DecodeResult]]:
         """Suspend EVERY resident session-tagged slot mid-stream with
         status ``"suspended"`` (partial tokens + the session attached) —
@@ -1310,6 +1381,7 @@ class SlotEngine:
                 out.append((slot.tag, self._finish(i, "suspended")))
         return out
 
+    @_serialized
     def drain_evict_all(self, status: str = "failed") -> List[Tuple[Any, DecodeResult]]:
         """Forcibly evict every resident request with partial tokens (the
         Server's last-resort path when the loop must exit NOW; the normal

@@ -187,6 +187,21 @@ class ServeConfig:
     # conservative — a draft accepting under ~1 token in 5 costs more
     # than it saves on any realistic cost ratio. 0 disables the floor.
     spec_min_accept: float = 0.2
+    # -- tensor-parallel decode (ISSUE 14): shard the batched decode
+    # over a tp-device mesh — weights by the training rules (heads/
+    # hidden on tp, wo/down psum-at-output: two all-reduces per block
+    # per step, golden decode_batched_tp{2,4}), the O(1) state on the
+    # head dimension, per-slot carry replicated. Emitted tokens are
+    # BITWISE the unsharded server's at the same seeds, and suspended
+    # sessions stay portable across footprints (the store holds the
+    # logical row; resharding is a host-side reshape at resume).
+    # 0/1 = unsharded. The process must expose >= tp devices.
+    tp: int = 0
+    # compile the pure decode program once at startup to report the
+    # collectives GSPMD actually inserted vs the declared budget
+    # (/statusz "mesh" section — a misconfigured mesh is visible before
+    # it is slow). Costs one extra AOT compile; tp>1 only.
+    mesh_audit: bool = True
 
 
 @dataclasses.dataclass
@@ -324,6 +339,37 @@ class Server:
             clock=clock, lock=self._stats_lock,
             on_transition=self._on_health,
         )
+        # tensor-parallel decode (ISSUE 14): build the tp mesh BEFORE the
+        # engine so placement fails loudly at construction (too few
+        # devices, never an opaque GSPMD error at the first chunk). The
+        # mesh report is computed here too — all host-side by the time
+        # any request arrives, so /statusz serves it without a device op.
+        self.tp = max(int(cfg.tp), 1)
+        self.mesh = None
+        self.mesh_info: Optional[dict] = None
+        if self.tp > 1:
+            from orion_tpu.parallel.decode import mesh_report, serving_mesh
+
+            self.mesh = serving_mesh(self.tp)
+            # the probe compiles the greedy-default program: the
+            # collective structure is sampling-independent (the
+            # all-reduces live in the blocks), and the engine's real
+            # SampleConfig is not known until the first admission
+            self.mesh_info = mesh_report(
+                model, params, self.mesh, cfg.slots, cfg.chunk,
+                _gen.SampleConfig(), compile_probe=cfg.mesh_audit,
+            )
+            if self.mesh_info.get("budget_ok") is False:
+                warnings.warn(
+                    "tp mesh audit: observed decode collectives "
+                    f"{self.mesh_info.get('observed_collectives')} do not "
+                    "match the declared per-step budget "
+                    f"({self.mesh_info.get('allreduces_per_step_budget')} "
+                    "all-reduces) — the mesh may not be engaging (head "
+                    "count not divisible by tp?); serving continues but "
+                    "the footprint is suspect (/statusz mesh section)",
+                    stacklevel=2,
+                )
         self.engine = SlotEngine(
             model, params, slots=cfg.slots, chunk=cfg.chunk, clock=clock,
             prefill_buckets=parse_buckets(
@@ -334,6 +380,7 @@ class Server:
             on_event=self._on_engine_event,
             spec_depth=cfg.spec_depth,
             spec_min_accept=cfg.spec_min_accept,
+            mesh=self.mesh,
         )
         # self-speculation telemetry (ISSUE 13): totals for the SLO
         # engine's rate views plus a per-turn acceptance-rate histogram
@@ -387,10 +434,14 @@ class Server:
             ("prefill_bucketed", _gen._prefill_carry_bucketed_jit),
         ):
             # host-side executable-cache introspection, not a device op —
-            # the gauge that proves telemetry added zero compiles
+            # the gauge that proves telemetry added zero compiles. The tp
+            # label says which footprint's programs fill the cache (each
+            # tp is its own compile key — the cache entries scale with
+            # the footprints a process hosts, and a mixed-footprint
+            # LocalReplica fleet must be attributable per mesh).
             self.metrics.gauge_fn(
                 "compile_cache_entries", jitted._cache_size,
-                labels={"cache": label},
+                labels={"cache": label, "tp": str(self.tp)},
             )
         # durable sessions: write-through disk store + a host-resident LRU
         # cache in front of it (resident entries are ALWAYS also on disk,
@@ -502,6 +553,14 @@ class Server:
         /metrics where a scraper wants it."""
         snap = self.snapshot()
         snap.pop("metrics", None)
+        if self.mesh_info is not None:
+            # the mesh section: axis sizes, per-device weight/state
+            # bytes, and declared-vs-observed per-step collectives — a
+            # replicating (misconfigured) mesh shows budget_ok=False and
+            # an un-divided param_bytes_per_device here, long before it
+            # shows up as a latency regression. Computed once at
+            # construction; this is a host dict read, never a device op.
+            snap["mesh"] = self.mesh_info
         if self.cfg.spec_depth:
             flat = self.metrics.counters_flat()
             snap["speculation"] = {
@@ -1098,7 +1157,10 @@ class Server:
             self._bump("chunks")
             self._bump("slot_steps_active", occupied)
             self._bump("slot_steps_total", self.engine.slots)
-            self._h_chunk_ms.observe(dt * 1e3)
+            # the tp label makes a fleet's per-footprint boundary cost
+            # separable at the aggregated endpoint (a tp=4 replica's
+            # chunks cost collectives a tp=1 replica's don't)
+            self._h_chunk_ms.observe(dt * 1e3, labels={"tp": str(self.tp)})
         for i, tag, phase, k in infos:
             self.trace.complete(
                 "decode_chunk" if phase == "decode" else "prefill_piece",

@@ -1464,6 +1464,49 @@ def test_spec_decode_golden_pins_the_verify_contract(fresh_snapshots):
     assert spec["spec_depth"] == 4 and spec["slots"] == 8
 
 
+def test_tp_decode_goldens_pin_the_megatron_contract(fresh_snapshots):
+    """ISSUE 14: the tp=2/tp=4 batched-decode artifacts pin (a) the
+    per-step collective budget EXACTLY — two all-reduces per block per
+    decode step (wo + down, the Megatron intra-layer contract) and NO
+    other collective kind: a third one is a leaked per-token cost no CPU
+    parity test would catch; (b) per-device scan-carry bytes = the
+    head-sharded state / tp plus ONLY the replicated per-slot
+    bookkeeping vectors (a few dozen bytes — asserted against the
+    unsharded target, slack documented); (c) the logical program
+    (jaxpr-level carry) unchanged by placement."""
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.parallel.decode import DECODE_ALLREDUCES_PER_BLOCK
+
+    plain = fresh_snapshots["decode_batched_tiny"]
+    n_blocks = get_config("tiny").n_layers
+    slots = plain["slots"]
+    vec_slack = slots * (3 * 4 + 1)  # token/t/emit int32 + done bool
+    for tp in (2, 4):
+        snap = fresh_snapshots[f"decode_batched_tp{tp}"]
+        coll = snap["hlo_collectives"]
+        assert coll["all-reduce"] == (
+            DECODE_ALLREDUCES_PER_BLOCK * n_blocks
+        ), (tp, coll)
+        assert all(
+            v == 0 for k, v in coll.items() if k != "all-reduce"
+        ), (tp, coll)
+        # the LOGICAL carry is placement-invariant...
+        assert snap["scan_carry_bytes"] == plain["scan_carry_bytes"]
+        # ...and the per-device share divides by tp up to the replicated
+        # per-slot vectors
+        per_dev = snap["scan_carry_bytes_per_device"]
+        assert per_dev <= plain["scan_carry_bytes"] // tp + vec_slack, (
+            tp, per_dev, plain["scan_carry_bytes"]
+        )
+        assert per_dev < plain["scan_carry_bytes"], tp
+        assert snap["mesh"] == {"tp": tp}
+        # weights actually sharded: per-device param bytes strictly
+        # below the tp=2 < unsharded relation is pinned transitively
+        assert snap["param_bytes_per_device"] > 0
+    assert (fresh_snapshots["decode_batched_tp4"]["param_bytes_per_device"]
+            < fresh_snapshots["decode_batched_tp2"]["param_bytes_per_device"])
+
+
 def test_donated_arg_aliasing_recorded_and_checked(fresh_snapshots):
     # the dp8 train step donates its whole TrainState; XLA must alias it
     d = fresh_snapshots["train_tiny_dp8"]["donation"]

@@ -34,6 +34,56 @@ def test_hybrid_7b_lowers_sharded():
     assert rep["param_bytes_per_device"] < 4e9, rep
 
 
+def test_decode_plan_inventories_serving_programs():
+    """ISSUE 14 satellite: ``aot.decode_plan`` lists EVERY executable a
+    replica of a given shape compiles — the batched decode per
+    (slots, chunk, qmode, tp), the unified prefill and host bucketed
+    prefill per bucket, the spec round per depth — the complete
+    inventory ROADMAP item 4's warm-start persistence needs. Lower-only
+    keeps the test cheap; the compiled/collectives path is covered by
+    the tp goldens and the CLI smoke."""
+    from orion_tpu.aot import decode_plan
+
+    cfg = get_config("tiny")
+    rep = decode_plan(
+        cfg, slots=4, chunk=8, prefill_buckets=(16, 32),
+        prefill_chunk=16, qmode="int8", spec_depth=2, compile_step=False,
+    )
+    kinds = [(p["kind"], p.get("bucket")) for p in rep["programs"]]
+    assert kinds == [
+        ("decode_batched", None),
+        ("unified_prefill", 16), ("prefill_bucketed", 16),
+        ("unified_prefill", 32), ("prefill_bucketed", 32),
+        ("spec_round", None),
+    ]
+    assert all(p.get("lowered") for p in rep["programs"]), rep["programs"]
+    assert rep["qmode"] == "int8" and rep["tp"] == 1
+    assert {p["qmode"] for p in rep["programs"]} == {"int8"}
+    # tp rides every program key: the warm-start cache must never hand a
+    # tp=2 replica an unsharded executable
+    assert {p["tp"] for p in rep["programs"]} == {1}
+    # the inventory lists the pchunk the ENGINE compiles, not the raw
+    # knob: SlotEngine rounds prefill_chunk up to the linear-attention
+    # chunk alignment, and prefill_chunk=0 (host-side prefill) has no
+    # unified program at all — phantom entries would defeat the
+    # "runs precisely these executables" warm-start contract
+    from orion_tpu.ops.dispatch import resolve, resolve_chunk
+
+    align = resolve_chunk(cfg.chunk, cfg.max_seq_len, resolve(cfg.backend))
+    rep2 = decode_plan(
+        cfg, slots=4, chunk=8, prefill_buckets=(32,),
+        prefill_chunk=align + 1, compile_step=False,
+    )
+    uni = [p for p in rep2["programs"] if p["kind"] == "unified_prefill"]
+    assert [p["prefill_chunk"] for p in uni] == [2 * align], uni
+    rep0 = decode_plan(
+        cfg, slots=4, chunk=8, prefill_buckets=(16,),
+        prefill_chunk=0, compile_step=False,
+    )
+    kinds0 = [p["kind"] for p in rep0["programs"]]
+    assert "unified_prefill" not in kinds0 and "prefill_bucketed" in kinds0
+
+
 def _topo_mesh_or_skip(mc):
     from orion_tpu.aot import topology_mesh
 

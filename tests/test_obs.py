@@ -578,7 +578,8 @@ def test_full_telemetry_adds_zero_compiles(mp, tmp_path):
     assert sizes() == before, "telemetry must add ZERO compiles"
     # and the telemetry actually ran — this wasn't a dark pass
     assert read_jsonl(str(tmp_path / "t.jsonl"))
-    assert srv._h_chunk_ms.cell()["count"] > 0
+    # chunk_ms cells carry the tp footprint label since ISSUE 14
+    assert srv._h_chunk_ms.cell(labels={"tp": "1"})["count"] > 0
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,520 @@
+"""Tensor-parallel batched decode suite (ISSUE 14).
+
+The acceptance proofs live here — (1) a tp=2 / tp=4 Server emits tokens
+BITWISE-identical to the unsharded server at the same seeds, greedy and
+sampled, with in-scan prefill and staggered admission; (2) a session
+suspended on a tp=2 replica resumes bitwise on a tp=4 AND an unsharded
+replica (and back) via the shared session store — resharding is a
+host-side reshape because the store holds the LOGICAL carry row; (3) a
+mixed-footprint LocalReplica fleet (tp=2 + unsharded) serves one
+conversation across a mid-stream drain with zero lost turns; plus the
+compile-budget / carry-sharding stability pins and the mesh-report
+misconfiguration alarm.
+
+Contract note (parallel/decode.py docstring): the cross-footprint
+bitwise contract is TOKEN-level. The two split contractions per block
+(wo/down psum) reassociate one float reduction each, so the state
+carries ~1-ulp noise across footprints — every test here therefore pins
+token streams (what clients see and what sessions replay), while the
+per-footprint suspend/resume round trip stays exact as in PR 6.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.fleet.replica import LocalReplica, ReplicaSpec, serve_config
+from orion_tpu.fleet.router import Router
+from orion_tpu.generate import (
+    SampleConfig,
+    _decode_batched_chunk_jit,
+    generate,
+)
+from orion_tpu.models.configs import ModelConfig
+from orion_tpu.models.transformer import TransformerLM, init_decode_state
+from orion_tpu.parallel.decode import (
+    carry_bytes_per_device,
+    decode_state_shardings,
+    mesh_report,
+    serving_mesh,
+)
+from orion_tpu.resilience import inject
+from orion_tpu.serving import (
+    DecodeRequest,
+    ServeConfig,
+    Server,
+    SlotEngine,
+)
+from orion_tpu.serving.session_store import SessionStore
+
+pytestmark = pytest.mark.chaos
+
+# the batching/session shape family with n_heads=4 so BOTH tp=2 and tp=4
+# divide the head dimension; one layer of each type so the head-sharded
+# placement covers (S, z), KV-cache, and ring-cache states alike
+CFG = ModelConfig(
+    name="tp_test", vocab_size=64, d_model=32, n_layers=3, n_heads=4,
+    layer_types=("linear", "softmax", "swa"), window=8, max_seq_len=96,
+    dtype="float32", backend="xla",
+)
+GREEDY = SampleConfig(temperature=0.0)
+SAMPLED = SampleConfig(temperature=0.8, top_k=5, top_p=0.9, eos_token=3,
+                       pad_token=0)
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = TransformerLM(CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _prompt(i, ln=5):
+    return jax.random.randint(
+        jax.random.PRNGKey(3000 + i), (1, ln), 0, CFG.vocab_size
+    ).astype(jnp.int32)
+
+
+def _ref(mp, prompt, n_new, sample, seed):
+    model, params = mp
+    return np.asarray(
+        generate(model, params, prompt, n_new, sample,
+                 rng=jax.random.PRNGKey(seed))
+    )
+
+
+def _serve_cfg(**kw):
+    # ONE engine shape for the whole module (slots=2, chunk=4, in-scan
+    # prefill, buckets 16/32) so every tp=2 test shares the same compiled
+    # programs — the suite's compile bill is per footprint, not per test
+    kw.setdefault("chunk", 4)
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_inflight", 8)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("prefill_buckets", "16,32")
+    return ServeConfig(**kw)
+
+
+def _run_turn(srv, prompt, want, sample, seed, sid=None):
+    p = srv.submit(DecodeRequest(
+        prompt=prompt, max_new_tokens=want, sample=sample, seed=seed,
+        session_id=sid,
+    ))
+    assert srv.serve(drain_when_idle=True) == 0
+    return p
+
+
+# ---------------------------------------------------------------------------
+# acceptance 1: server-level bitwise token parity, tp vs unsharded
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+@pytest.mark.parametrize("sample", [GREEDY, SAMPLED], ids=["greedy", "sampled"])
+def test_tp_server_parity_bitwise(mp, tp, sample):
+    """N > slots requests through a tp Server — staggered admission (the
+    queue refills freed slots at boundaries), in-scan prefill on, varying
+    prompt lengths. Every request's tokens must be BITWISE what the
+    monolithic solo scan on UNSHARDED params produces at the same seed:
+    which footprint served a request must be invisible in its tokens."""
+    model, params = mp
+    n = 4
+    prompts = [_prompt(i, ln=3 + i) for i in range(n)]
+    refs = [
+        _ref(mp, p, 8, sample, seed=700 + i) for i, p in enumerate(prompts)
+    ]
+    srv = Server(model, params, _serve_cfg(tp=tp, mesh_audit=False))
+    ps = [
+        srv.submit(DecodeRequest(prompt=p, max_new_tokens=8, sample=sample,
+                                 seed=700 + i))
+        for i, p in enumerate(prompts)
+    ]
+    assert srv.serve(drain_when_idle=True) == 0
+    for i, (p, ref) in enumerate(zip(ps, refs)):
+        assert p.result is not None and p.result.status == "ok", i
+        np.testing.assert_array_equal(
+            p.result.tokens, ref, err_msg=f"tp={tp} request {i}"
+        )
+    srv.close()
+
+
+def test_tp_poisoned_slot_rewinds_bitwise(mp):
+    """The per-slot ladder under tp=2: slot 0's state is poisoned at
+    chunk 1 — the rewind replays the batched chunk from the boundary
+    snapshot on the sharded carry, and BOTH requests still finish
+    bitwise vs their unsharded solo runs."""
+    model, params = mp
+    prompts = [_prompt(20), _prompt(21, ln=6)]
+    refs = [
+        _ref(mp, p, 8, SAMPLED, seed=800 + i) for i, p in enumerate(prompts)
+    ]
+    srv = Server(model, params, _serve_cfg(tp=2, mesh_audit=False))
+    plan = inject.FaultPlan().poison_decode_slot_at(0, 1, times=1)
+    with inject.inject(plan):
+        ps = [
+            srv.submit(DecodeRequest(prompt=p, max_new_tokens=8,
+                                     sample=SAMPLED, seed=800 + i))
+            for i, p in enumerate(prompts)
+        ]
+        assert srv.serve(drain_when_idle=True) == 0
+    assert plan.delivered
+    for p, ref in zip(ps, refs):
+        assert p.result.status == "ok"
+        np.testing.assert_array_equal(p.result.tokens, ref)
+    assert srv.stats["rewinds"] >= 1
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance 2: session resharding across footprints
+# ---------------------------------------------------------------------------
+
+
+def _session_cfg(tmp_path, tp=0, **kw):
+    return _serve_cfg(
+        session_dir=str(tmp_path / "sessions"), tp=tp, mesh_audit=False,
+        **kw,
+    )
+
+
+@pytest.mark.parametrize("sample", [GREEDY, SAMPLED], ids=["greedy", "sampled"])
+def test_session_reshards_tp2_tp4_unsharded_bitwise(mp, tmp_path, sample):
+    """THE portability proof: turn 1 on a tp=2 server, turn 2 on a tp=4
+    server, turn 3 on an UNSHARDED server — all through the shared
+    session store, each resume a host-side reshape of the logical carry
+    row (no KV transfer: the store bytes ARE footprint-free). The
+    concatenated turns must be bitwise ONE uninterrupted solo run."""
+    model, params = mp
+    prompt = _prompt(30)
+    ref = _ref(mp, prompt, 24, sample, seed=42)
+    cont = np.zeros((1, 0), np.int32)
+    srv1 = Server(model, params, _session_cfg(tmp_path, tp=2))
+    p1 = _run_turn(srv1, prompt, 10, sample, 42, "conv")
+    assert p1.result.status == "ok"
+    srv1.close()
+    srv2 = Server(model, params, _session_cfg(tmp_path, tp=4))
+    p2 = _run_turn(srv2, cont, 6, sample, 0, "conv")
+    assert p2.result.status == "ok"
+    srv2.close()
+    srv3 = Server(model, params, _session_cfg(tmp_path, tp=0))
+    p3 = _run_turn(srv3, cont, 8, sample, 0, "conv")
+    assert p3.result.status == "ok"
+    srv3.close()
+    np.testing.assert_array_equal(
+        np.concatenate(
+            [p1.result.tokens, p2.result.tokens, p3.result.tokens], axis=1
+        ),
+        ref,
+    )
+
+
+def test_session_reshards_unsharded_to_tp_bitwise(mp, tmp_path):
+    """The reverse direction: suspended UNSHARDED, resumed at tp=2 —
+    up-sharding an existing conversation onto a mesh replica."""
+    model, params = mp
+    prompt = _prompt(31)
+    ref = _ref(mp, prompt, 16, GREEDY, seed=9)
+    srv1 = Server(model, params, _session_cfg(tmp_path, tp=0))
+    p1 = _run_turn(srv1, prompt, 8, GREEDY, 9, "conv")
+    srv1.close()
+    srv2 = Server(model, params, _session_cfg(tmp_path, tp=2))
+    p2 = _run_turn(srv2, np.zeros((1, 0), np.int32), 8, GREEDY, 0, "conv")
+    srv2.close()
+    np.testing.assert_array_equal(
+        np.concatenate([p1.result.tokens, p2.result.tokens], axis=1), ref
+    )
+
+
+def test_corrupt_manifest_falls_back_on_resharded_generation(mp, tmp_path):
+    """Two tp=2 turns commit generations 1 and 2; generation 2's payload
+    is corrupted on disk. An UNSHARDED server resuming the conversation
+    falls back to generation 1 (loud warning) and re-decodes turn 2's
+    tokens bitwise — the fallback path and the reshard path compose."""
+    from orion_tpu.resilience.inject import corrupt_session
+
+    model, params = mp
+    prompt = _prompt(32)
+    srv1 = Server(model, params, _session_cfg(tmp_path, tp=2))
+    p1 = _run_turn(srv1, prompt, 8, GREEDY, 11, "conv")
+    p2 = _run_turn(srv1, np.zeros((1, 0), np.int32), 8, GREEDY, 0, "conv")
+    srv1.close()
+    store_dir = str(tmp_path / "sessions")
+    assert SessionStore(store_dir).newest_generation("conv") == 2
+    corrupt_session(store_dir, "conv", generation=2)
+    srv2 = Server(model, params, _session_cfg(tmp_path, tp=0))
+    with pytest.warns(UserWarning, match="falling back"):
+        p3 = _run_turn(srv2, np.zeros((1, 0), np.int32), 8, GREEDY, 0,
+                       "conv")
+    srv2.close()
+    assert p3.result.status == "ok"
+    # generation 1 = the carry right after turn 1: the re-decode replays
+    # turn 2's tokens exactly (determinism is the fallback's safety net)
+    np.testing.assert_array_equal(p3.result.tokens, p2.result.tokens)
+    assert p1.result.new_tokens == 8
+
+
+# ---------------------------------------------------------------------------
+# acceptance 3: mixed-footprint fleet across a drain
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_footprint_fleet_drain_zero_lost_turns(mp, tmp_path):
+    """One fleet, two footprints: replica A serves tp=2, replica B
+    unsharded, both behind one router over one shared session store. A
+    conversation starts on A, A is drained MID-stream (suspends the
+    session at the next boundary), and the continuation lands on B —
+    concatenation bitwise an uninterrupted solo run, zero lost turns."""
+    model, params = mp
+    want = 24
+    prompt = _prompt(40)
+    ref = _ref(mp, prompt, want, GREEDY, seed=55)
+    a = LocalReplica(
+        model, params, _session_cfg(tmp_path, tp=2), name="tp2-0"
+    ).start()
+    b = LocalReplica(
+        model, params, _session_cfg(tmp_path, tp=0), name="plain-0"
+    ).start()
+    router = Router([a, b])
+    try:
+        a.wait_ready(30.0)
+        b.wait_ready(30.0)
+        plan = inject.FaultPlan().add(
+            "serve.chunk", step=2, times=1, action=a.drain
+        )
+        with inject.inject(plan):
+            p1 = router.submit(DecodeRequest(
+                prompt=prompt, max_new_tokens=want, sample=GREEDY, seed=55,
+                session_id="conv",
+            ))
+            assert p1.done.wait(timeout=120.0)
+        assert plan.delivered, "drain must land mid-stream"
+        assert p1.result.status == "suspended"
+        assert 0 < p1.result.new_tokens < want
+        assert a.join(timeout=30.0)
+        left = want - p1.result.new_tokens
+        p2 = router.submit(DecodeRequest(
+            prompt=np.zeros((1, 0), np.int32), max_new_tokens=left,
+            sample=GREEDY, seed=0, session_id="conv",
+        ))
+        assert p2.done.wait(timeout=120.0)
+        assert p2.result.status == "ok"
+        # the continuation could only have run on B: A is drained dead
+        assert b.server.stats["ok"] >= 1
+        np.testing.assert_array_equal(
+            np.concatenate([p1.result.tokens, p2.result.tokens], axis=1),
+            ref,
+        )
+    finally:
+        a.drain()
+        b.drain()
+        a.join(timeout=30.0)
+        b.join(timeout=30.0)
+
+
+def test_same_footprint_local_fleet_no_rendezvous_deadlock(mp):
+    """TWO tp=2 LocalReplicas in ONE process share the same two virtual
+    devices. XLA-CPU executes a multi-device program by rendezvousing one
+    thread per device at each collective, so two mesh engines launching
+    collective programs concurrently can CROSS their rendezvous (rank 0
+    joins A's all-reduce while rank 1 joins B's) and hang forever —
+    batching._TP_EXEC_LOCK serializes mesh-engine program launches so
+    this fleet completes instead of deadlocking, and the served tokens
+    stay bitwise the solo runs' regardless of which replica won each
+    request."""
+    model, params = mp
+    want = 8
+    prompts = [_prompt(50 + i, ln=4 + (i % 3)) for i in range(4)]
+    refs = [
+        _ref(mp, p, want, GREEDY, seed=900 + i)
+        for i, p in enumerate(prompts)
+    ]
+    a = LocalReplica(
+        model, params, _serve_cfg(tp=2, mesh_audit=False), name="tp2-a"
+    ).start()
+    b = LocalReplica(
+        model, params, _serve_cfg(tp=2, mesh_audit=False), name="tp2-b"
+    ).start()
+    router = Router([a, b])
+    try:
+        a.wait_ready(30.0)
+        b.wait_ready(30.0)
+        ps = [
+            router.submit(DecodeRequest(
+                prompt=p, max_new_tokens=want, sample=GREEDY, seed=900 + i,
+            ))
+            for i, p in enumerate(prompts)
+        ]
+        for i, p in enumerate(ps):
+            # a bounded wait IS the regression assertion: without the
+            # exec lock this hangs in the crossed rendezvous
+            assert p.done.wait(timeout=120.0), (
+                f"request {i} never finished — collective rendezvous "
+                "crossed between co-resident tp replicas?"
+            )
+            assert p.result.status == "ok", i
+            np.testing.assert_array_equal(
+                p.result.tokens, refs[i], err_msg=f"request {i}"
+            )
+        # both replicas actually served (the router spreads load; if one
+        # replica took everything the test degenerates to single-engine)
+        assert a.server.stats["ok"] + b.server.stats["ok"] == len(ps)
+    finally:
+        a.drain()
+        b.drain()
+        a.join(timeout=30.0)
+        b.join(timeout=30.0)
+
+
+def test_replica_spec_tp_footprint_rides_serve_config():
+    """ReplicaSpec.tp is the footprint: it survives the JSON round trip
+    (the wire format every child is built from) and overrides the serve
+    dict in serve_config — one source of truth for placement."""
+    spec = ReplicaSpec(config="tiny", tp=2, serve={"slots": 4})
+    spec2 = ReplicaSpec.from_json(spec.to_json())
+    assert spec2.tp == 2
+    cfg = serve_config(spec2)
+    assert cfg.tp == 2 and cfg.slots == 4
+    # 0/1 leaves the serve dict's choice alone
+    assert serve_config(ReplicaSpec(config="tiny", tp=0)).tp == 0
+    # a footprint expressed ONLY in the serve dict still counts — the
+    # child keys device provisioning off replica_footprint, and a spec
+    # that serves tp=2 without provisioning 2 devices is a crash loop
+    from orion_tpu.fleet.replica import replica_footprint
+
+    only_serve = ReplicaSpec(config="tiny", tp=0, serve={"tp": 2})
+    assert replica_footprint(only_serve) == 2
+    assert serve_config(only_serve).tp == 2
+    # spec.tp is the replica's placement truth: it wins a disagreement
+    both = ReplicaSpec(config="tiny", tp=4, serve={"tp": 2})
+    assert replica_footprint(both) == 4
+    assert serve_config(both).tp == 4
+
+
+# ---------------------------------------------------------------------------
+# compile budget + carry sharding stability
+# ---------------------------------------------------------------------------
+
+
+def test_tp_engine_compile_budget_and_stable_sharding(mp):
+    """The engine's one-compile-per-(slots, chunk, tp) contract holds
+    under a mesh, and the carry's state sharding is STABLE across
+    admission, chunks, and eviction — placement drift would show up as
+    silent extra compiles (each novel sharding is its own cache key)."""
+    model, params = mp
+    mesh = serving_mesh(2)
+    eng = SlotEngine(model, params, slots=2, chunk=4, mesh=mesh,
+                     prefill_buckets=(16, 32), prefill_chunk=8)
+    before = _decode_batched_chunk_jit._cache_size()
+
+    def state_shardings():
+        return {
+            str(x.sharding.spec) for x in jax.tree.leaves(eng._carry[1])
+        }
+
+    sharded0 = state_shardings()
+    assert any("'tp'" in s for s in sharded0), sharded0
+    done = {}
+    for i in range(2):
+        eng.admit(DecodeRequest(prompt=_prompt(50 + i, ln=4 + i),
+                                max_new_tokens=12, sample=GREEDY,
+                                seed=900 + i), tag=i)
+    for _ in range(8):
+        done.update(dict(eng.step()))
+        assert any("'tp'" in s for s in state_shardings())
+    assert set(done) == {0, 1}
+    # one more admission re-using the warm programs: zero new compiles
+    eng.admit(DecodeRequest(prompt=_prompt(52), max_new_tokens=4,
+                            sample=GREEDY, seed=902), tag=2)
+    for _ in range(4):
+        done.update(dict(eng.step()))
+    assert _decode_batched_chunk_jit._cache_size() - before <= 1, (
+        "the tp engine must cost at most ONE decode compile for its "
+        "(slots, chunk, tp) key over its whole lifetime"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the mesh report: a misconfigured mesh is visible before it is slow
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_report_engaged_vs_misconfigured(mp):
+    model, params = mp
+    mesh = serving_mesh(2)
+    rep = mesh_report(model, params, mesh, slots=2, chunk=4,
+                      sample=GREEDY, compile_probe=True)
+    assert rep["tp"] == 2
+    assert rep["allreduces_per_step_budget"] == 2 * CFG.n_layers
+    assert rep["budget_ok"] is True
+    assert rep["observed_collectives"]["all-reduce"] == 2 * CFG.n_layers
+    assert rep["param_bytes_per_device"] < rep["param_bytes"]
+    assert rep["carry_bytes_per_device"] < rep["carry_bytes"]
+    # head/feature dims that do not divide tp clip to replicated: the
+    # report must SAY so (observed collectives miss the budget, state
+    # bytes don't divide) instead of letting the operator discover the
+    # silently-replicating mesh as a latency number. d_model=30/heads=3
+    # on a tp=4 mesh: attention dims clip (3 heads, 30 features), only
+    # the 120-wide MLP hidden still shards.
+    mesh4 = serving_mesh(4)
+    bad_cfg = dataclasses.replace(CFG, n_heads=3, d_model=30, name="bad")
+    bad_model = TransformerLM(bad_cfg)
+    bad_params = bad_model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    bad = mesh_report(bad_model, bad_params, mesh4, slots=2, chunk=4,
+                      sample=GREEDY, compile_probe=True)
+    assert bad["budget_ok"] is False
+    assert bad["state_bytes_per_device"] == bad["state_bytes"]
+
+
+def test_serving_mesh_refuses_too_few_devices():
+    with pytest.raises(ValueError, match="devices"):
+        serving_mesh(1024)
+
+
+def test_statusz_mesh_section_and_tp_metric_labels(mp):
+    """/statusz carries the mesh section and the chunk_ms / compile-cache
+    cells carry the tp footprint label — the obs satellite."""
+    model, params = mp
+    srv = Server(model, params, _serve_cfg(tp=2, mesh_audit=False))
+    _run_turn(srv, _prompt(60), 4, GREEDY, 1)
+    snap = srv._statusz()
+    assert snap["mesh"]["tp"] == 2
+    assert snap["mesh"]["allreduces_per_step_budget"] == 2 * CFG.n_layers
+    assert "observed_collectives" not in snap["mesh"]  # audit off
+    assert srv._h_chunk_ms.cell(labels={"tp": "2"})["count"] > 0
+    m = srv.metrics.snapshot()
+    caches = [g for g in m["gauges"] if g["name"] == "compile_cache_entries"]
+    assert caches and all(g["labels"]["tp"] == "2" for g in caches)
+    srv.close()
+
+
+def test_mesh_audit_probe_fills_statusz_observed(mp):
+    model, params = mp
+    srv = Server(model, params, _serve_cfg(tp=2, mesh_audit=True))
+    assert srv.mesh_info["budget_ok"] is True
+    assert (srv.mesh_info["observed_collectives"]["all-reduce"]
+            == 2 * CFG.n_layers)
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# per-device carry accounting (the golden's companion unit check)
+# ---------------------------------------------------------------------------
+
+
+def test_carry_bytes_per_device_divides_state_only():
+    mesh = serving_mesh(4)
+    acct = carry_bytes_per_device(CFG, slots=8, mesh=mesh)
+    assert acct["state_bytes_per_device"] * 4 == acct["state_bytes"]
+    assert (acct["carry_bytes_per_device"]
+            == acct["state_bytes_per_device"]
+            + acct["replicated_vector_bytes"])
+    # the sharding spec itself: head axis on tp, slot axis untouched
+    states = jax.eval_shape(lambda: init_decode_state(CFG, 8))
+    for shd in jax.tree.leaves(decode_state_shardings(states, mesh)):
+        spec = tuple(shd.spec)
+        assert not spec or spec[0] is None, "slot axis must never shard"
