@@ -19,10 +19,15 @@ be PURE host code. Two scopes enforce that:
    to the spine as a callback — ``gauge_fn(...)`` callables, inject
    ``add_observer`` subscribers, ``attach_inject`` targets, callables
    bound to the hook keywords ``on_event`` / ``on_transition`` /
-   ``on_done`` / ``on_stop`` / ``observer``, and (since ISSUE 10) the
+   ``on_done`` / ``on_stop`` / ``observer``, (since ISSUE 10) the
    live-endpoint provider keywords ``metrics_fn`` / ``health_fn`` /
    ``statusz_fn`` / ``slo_fn`` (obs/http.py handlers call them from
-   scrape threads) — runs on the scheduler's hot path (chunk
+   scrape threads), and (since ISSUE 15) the cost surfaces — the
+   ``costz_fn`` / ``profilez_fn`` endpoint providers, ``cost_fn`` /
+   ``capacity_fn`` callbacks, and any ``*_cost``-named function passed
+   as a callback argument to ANY call (a cost provider by naming
+   contract, wherever it gets registered) — runs on the scheduler's
+   hot path (chunk
    boundaries, signal delivery, metric scrapes). Inside such functions
    (named functions resolved same-module, plus inline lambdas), the
    sync-shaped calls above and any ``jax.``/``jnp.`` dotted call are
@@ -58,6 +63,11 @@ _HOOK_KEYWORDS = frozenset({
     # must never sync a device value, so every registered provider is
     # in the banned-sync scope wherever it is defined
     "metrics_fn", "health_fn", "statusz_fn", "slo_fn",
+    # ISSUE 15 cost/capacity surfaces: the /costz and /profilez
+    # providers plus any cost/capacity callback handed to the spine —
+    # cost accounting runs once per chunk boundary and per scrape, the
+    # two hottest host paths there are
+    "costz_fn", "profilez_fn", "cost_fn", "capacity_fn",
 })
 
 
@@ -117,6 +127,14 @@ def _hook_functions(ctx: ModuleContext) -> List[ast.AST]:
             for kw in node.keywords:
                 if kw.arg in _HOOK_KEYWORDS:
                     claim(kw.value)
+            # a *_cost-named function passed as a callback ANYWHERE is a
+            # cost provider by naming contract (ISSUE 15): whatever call
+            # registers it — a spine keyword we enumerated or a future
+            # registrar we didn't — its body is banned-sync scope
+            for expr in list(node.args) + [kw.value for kw in node.keywords]:
+                name = dotted_name(expr)
+                if name and name.rsplit(".", 1)[-1].endswith("_cost"):
+                    claim(expr)
         elif isinstance(node, ast.Assign):
             # `pending.on_done = fn` — hook registration by assignment
             for target in node.targets:

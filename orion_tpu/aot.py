@@ -153,40 +153,16 @@ def plan(
     return report
 
 
-def decode_plan(
-    model_cfg,
-    slots: int = 8,
-    chunk: int = 16,
-    prefill_buckets=(),
-    prefill_chunk: int = 0,
-    qmode: str = "off",
-    tp: int = 0,
-    spec_depth: int = 0,
-    compile_step: bool = True,
-) -> Dict[str, Any]:
-    """The SERVING-side inventory ``plan`` never had (ISSUE 14): every
-    decode/prefill executable a replica of this shape compiles, keyed
-    exactly like the jit caches — (slots, chunk, bucket, qmode, tp) —
-    lowered (and optionally compiled) against abstract sharded state.
-    This is the complete program list ROADMAP item 4's warm-start work
-    needs to persist: a respawned replica serving these shapes runs
-    precisely these executables, nothing else (the engine's
-    one-compile-per-key contract is cache-stat-asserted in tests).
-
-    Per program: the GSPMD collectives (for tp plans: the two
-    per-block all-reduces per decode step — evidence the mesh engaged)
-    and the compiler's code size, the artifact a warm-start cache would
-    key and store."""
+def _decode_abstracts(model_cfg, slots: int, qmode: str, tp: int):
+    """Abstract (model, params, carry, rngs, active, shaped) for lowering
+    the serving decode programs — shared by :func:`decode_plan` and
+    :func:`decode_cost_entries` so the two can never key off different
+    shapes. With ``tp > 1`` everything carries the serving mesh's
+    NamedShardings (params by the training rules, state head-sharded,
+    per-slot vectors replicated)."""
     import jax
     import jax.numpy as jnp
 
-    from orion_tpu.generate import (
-        SampleConfig,
-        _decode_batched_chunk_jit,
-        _decode_batched_prefill_chunk_jit,
-        _decode_batched_spec_round_jit,
-        _prefill_carry_bucketed_jit,
-    )
     from orion_tpu.models.transformer import TransformerLM, init_decode_state
 
     tp = max(int(tp), 1)
@@ -230,6 +206,166 @@ def decode_plan(
     )
     rngs = shaped((slots, 2), jnp.uint32)
     active = vec(jnp.bool_)
+    return model, params, carry, rngs, active, shaped
+
+
+def _lowered_cost(lowered) -> Dict[str, Any]:
+    """Flops/bytes from a Lowered's HLO cost analysis, normalized to one
+    flat dict (some jax versions return a per-device list)."""
+    ca = lowered.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    out: Dict[str, Any] = {}
+    for src, dst in (("flops", "flops"), ("bytes accessed", "bytes_accessed"),
+                     ("transcendentals", "transcendentals")):
+        v = ca.get(src)
+        if v is not None:
+            out[dst] = float(v)
+    return out
+
+
+# identity -> harvested entries; the harvest is pure (abstract shapes in,
+# cost numbers out), so one process-wide memo makes repeated Server
+# constructions of the same engine shape free after the first
+_COST_MEMO: Dict[tuple, list] = {}
+
+
+def decode_cost_entries(
+    model_cfg,
+    slots: int = 8,
+    chunk: int = 16,
+    bucket: int = 0,
+    prefill_chunk: int = 0,
+    qmode: str = "off",
+    tp: int = 0,
+    spec_depth: int = 0,
+) -> list:
+    """The cost-ledger harvest (ISSUE 15): LOWER (never compile — the
+    jit caches stay untouched, which the zero-compile acceptance pins)
+    each decode program this engine shape actually runs and extract XLA
+    ``cost_analysis()`` flops/bytes. Returns entries
+    ``{"kind", "key", "flops", "bytes_accessed", ...}`` keyed by the
+    golden-snapshot identity. ``bucket`` is the staged-buffer width the
+    unified program is costed at (the engine's largest prefill bucket —
+    the worst-case piece); a per-program failure is recorded on its
+    entry, never raised: serving must come up even when the harvest
+    can't."""
+    import time as _time
+
+    tp = max(int(tp), 1)
+    memo_key = (repr(model_cfg), slots, chunk, int(bucket),
+                int(prefill_chunk), qmode, tp, int(spec_depth))
+    got = _COST_MEMO.get(memo_key)
+    if got is not None:
+        return [dict(e) for e in got]
+
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import (
+        SampleConfig,
+        _decode_batched_chunk_jit,
+        _decode_batched_prefill_chunk_jit,
+        _decode_batched_spec_round_jit,
+    )
+    from orion_tpu.obs.cost import program_key
+
+    model, params, carry, rngs, active, shaped = _decode_abstracts(
+        model_cfg, slots, qmode, tp
+    )
+    vec = lambda dt: shaped((slots,), dt)  # noqa: E731
+    sample = SampleConfig()
+    base = {"slots": slots, "chunk": chunk, "qmode": qmode, "tp": tp}
+    entries = []
+
+    def harvest(kind: str, key: Dict[str, Any], lower) -> None:
+        entry: Dict[str, Any] = {
+            "kind": kind, "key": program_key(kind, **key),
+        }
+        t0 = _time.monotonic()
+        try:
+            entry.update(_lowered_cost(lower()))
+            entry["lower_ms"] = round((_time.monotonic() - t0) * 1e3, 3)
+        except Exception as e:  # surface on the entry, never crash serving
+            entry["error"] = f"{type(e).__name__}: {e}"[:200]
+        entries.append(entry)
+
+    harvest("decode_batched", dict(base), lambda: (
+        _decode_batched_chunk_jit.lower(
+            model, params, carry, rngs, active, int(chunk), sample
+        )
+    ))
+    pchunk = 0
+    if int(prefill_chunk) > 0 and int(bucket) > 0:
+        from orion_tpu.ops.dispatch import resolve, resolve_chunk
+
+        align = resolve_chunk(
+            model_cfg.chunk, model_cfg.max_seq_len, resolve(model_cfg.backend)
+        )
+        pchunk = -(-int(prefill_chunk) // align) * align
+        pbuf = shaped((slots, int(bucket)), jnp.int32)
+        harvest(
+            "unified_prefill",
+            dict(base, bucket=int(bucket), prefill_chunk=pchunk),
+            lambda: _decode_batched_prefill_chunk_jit.lower(
+                model, params, carry, rngs, active, pbuf,
+                vec(jnp.int32), vec(jnp.int32), int(chunk),
+                min(pchunk, int(bucket)), sample,
+            ),
+        )
+    if int(spec_depth) > 0:
+        harvest(
+            "spec_round",
+            {"slots": slots, "spec_depth": int(spec_depth),
+             "qmode": qmode, "tp": tp},
+            lambda: _decode_batched_spec_round_jit.lower(
+                model, params, carry, rngs, active, vec(jnp.bool_),
+                int(spec_depth), sample,
+            ),
+        )
+    _COST_MEMO[memo_key] = [dict(e) for e in entries]
+    return entries
+
+
+def decode_plan(
+    model_cfg,
+    slots: int = 8,
+    chunk: int = 16,
+    prefill_buckets=(),
+    prefill_chunk: int = 0,
+    qmode: str = "off",
+    tp: int = 0,
+    spec_depth: int = 0,
+    compile_step: bool = True,
+) -> Dict[str, Any]:
+    """The SERVING-side inventory ``plan`` never had (ISSUE 14): every
+    decode/prefill executable a replica of this shape compiles, keyed
+    exactly like the jit caches — (slots, chunk, bucket, qmode, tp) —
+    lowered (and optionally compiled) against abstract sharded state.
+    This is the complete program list ROADMAP item 4's warm-start work
+    needs to persist: a respawned replica serving these shapes runs
+    precisely these executables, nothing else (the engine's
+    one-compile-per-key contract is cache-stat-asserted in tests).
+
+    Per program: the GSPMD collectives (for tp plans: the two
+    per-block all-reduces per decode step — evidence the mesh engaged)
+    and the compiler's code size, the artifact a warm-start cache would
+    key and store."""
+    import jax.numpy as jnp
+
+    from orion_tpu.generate import (
+        SampleConfig,
+        _decode_batched_chunk_jit,
+        _decode_batched_prefill_chunk_jit,
+        _decode_batched_spec_round_jit,
+        _prefill_carry_bucketed_jit,
+    )
+
+    tp = max(int(tp), 1)
+    model, params, carry, rngs, active, shaped = _decode_abstracts(
+        model_cfg, slots, qmode, tp
+    )
+    vec = lambda dt: shaped((slots,), dt)  # noqa: E731
     sample = SampleConfig()
     base_key = {"slots": slots, "chunk": chunk, "qmode": qmode, "tp": tp}
 
@@ -240,6 +376,13 @@ def decode_plan(
         try:
             lowered = lower()
             entry["lowered"] = True
+            try:
+                # the cost-ledger figures (ISSUE 15) ride the inventory
+                # too: the warm-start program list doubles as the fleet's
+                # per-program price sheet
+                entry["cost"] = _lowered_cost(lowered)
+            except Exception as e:
+                entry["cost_error"] = f"{type(e).__name__}: {e}"[:120]
             if compile_step:
                 compiled = lowered.compile()
                 entry["compiled"] = True

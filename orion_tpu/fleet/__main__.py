@@ -136,6 +136,17 @@ def build_argparser() -> argparse.ArgumentParser:
                         "(router/supervisor black box) AND every "
                         "replica; dumps fire on DEGRADED/drain/ladder "
                         "exhaustion/child exit")
+    p.add_argument("--no-cost", action="store_true",
+                   help="disable per-request cost attribution + the "
+                        "capacity model inside every replica (on by "
+                        "default; the fleet /metrics.json then carries "
+                        "an aggregated capacity/headroom section)")
+    p.add_argument("--profile-dir", default=None,
+                   help="arm-able jax.profiler capture inside every "
+                        "replica (each child writes to "
+                        "<dir>/<replica>); trigger via a replica's "
+                        "/profilez?chunks=K endpoint — off by default, "
+                        "flight-recorded when it fires")
     p.add_argument("--heartbeat-s", type=float, default=1.0,
                    help="supervisor heartbeat interval")
     p.add_argument("--grace", type=float, default=30.0)
@@ -167,6 +178,11 @@ def _spec_from_args(args) -> ReplicaSpec:
         "spec_depth": args.spec_depth,
         "spec_min_accept": args.spec_min_accept,
         "prefix_dir": args.prefix_dir,
+        # cost attribution + capacity inside every replica; the ledger
+        # harvest (a one-time lower at child startup, memoized) gives
+        # the fleet real flops figures instead of the analytic fallback
+        "cost": not args.no_cost,
+        "cost_ledger": not args.no_cost,
         # params_id is NOT set here: every replica derives it from the
         # weights it actually loads (build_model — config + overrides +
         # resolved checkpoint STEP or init seed), so a fleet restarted
@@ -206,6 +222,10 @@ def _obs_serve_overrides(args, name: str) -> dict:
         out["trace_path"] = f"{args.trace_path}.{name}.jsonl"
     if args.flight_dir:
         out["flight_dir"] = args.flight_dir
+    if args.profile_dir:
+        import os as _os
+
+        out["profile_dir"] = _os.path.join(args.profile_dir, name)
     return out
 
 
@@ -353,10 +373,20 @@ def main(argv=None) -> int:
             print(line + tok.decode(ids) + tag)
         snap = sup.router.snapshot()
         print(f"fleet: {snap}", file=sys.stderr)
-        if args.metrics_path:
+        if args.metrics_path or not args.no_cost:
             # scrape while the children still answer status — after the
             # drain there is nobody to ask
             aggregated = sup.aggregate_metrics()
+            cap = aggregated.get("capacity") or {}
+            if not cap.get("no_data"):
+                print(
+                    f"fleet capacity: ceiling "
+                    f"{cap['ceiling_tokens_per_s']} tok/s, current "
+                    f"{cap['current_tokens_per_s']} tok/s, headroom "
+                    f"{cap['headroom']:.3f} over "
+                    f"{cap['replicas_reporting']} replica(s)",
+                    file=sys.stderr,
+                )
     finally:
         sup.drain_all(timeout=args.grace * 2)
         if http is not None:
@@ -400,6 +430,12 @@ def _fleet_metrics(sup) -> dict:
             names.append(replica.name)
     agg = aggregate(snaps, sources=names)
     agg["replicas"] = len(names)
+    # same recomputed fleet headroom as Supervisor.aggregate_metrics
+    # (the summed headroom gauge is meaningless; this is the autoscaler
+    # number, served live on /metrics.json)
+    from orion_tpu.obs.cost import fleet_capacity
+
+    agg["capacity"] = fleet_capacity(agg)
     return agg
 
 

@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from orion_tpu.obs import cost as obs_cost
 from orion_tpu.obs import flight
 from orion_tpu.obs import metrics as obs_metrics
 from orion_tpu.resilience.inject import fire
@@ -269,6 +270,12 @@ class Supervisor:
             names.append(replica.name)
         agg = obs_metrics.aggregate(snaps, sources=names)
         agg["replicas"] = len(names)
+        # the ONE capacity figure a scale-out decision keys on (ISSUE
+        # 15): headroom recomputed from the SUMMED ceiling/current
+        # gauges — the per-replica headroom FRACTIONS also sum in the
+        # gauge rollup above, which is meaningless; this section is the
+        # number the future autoscaler reads
+        agg["capacity"] = obs_cost.fleet_capacity(agg)
         return agg
 
     # -- monitor thread -------------------------------------------------------

@@ -716,6 +716,26 @@ def decode_batched_spec_round(
     )
 
 
+# -- serving program identities (ISSUE 15) ------------------------------------
+# The canonical name -> jit-wrapper registry for every program the serving
+# path launches. Observability keys off these names: the Server's
+# compile_cache_entries gauges iterate it, the cost ledger's harvest
+# (aot.decode_cost_entries) and the engine's first-call compile-time
+# observations use the same kinds, and obs.cost.program_key() renders the
+# (slots, chunk, bucket, qmode, tp) identity string the golden snapshots
+# and aot.decode_plan pin — ONE vocabulary from compiled program to fleet
+# endpoint, so a /costz row, a cache gauge, and a golden snapshot can
+# never name the same program three different ways.
+
+DECODE_PROGRAMS = {
+    "decode_batched": _decode_batched_chunk_jit,
+    "unified_prefill": _decode_batched_prefill_chunk_jit,
+    "spec_round": _decode_batched_spec_round_jit,
+    "prefill": _prefill_carry_jit,
+    "prefill_bucketed": _prefill_carry_bucketed_jit,
+}
+
+
 def generate_chunked(
     model: TransformerLM,
     params: Any,

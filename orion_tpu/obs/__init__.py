@@ -35,10 +35,21 @@ thread, so full telemetry costs host timestamps, never a device sync):
 - :mod:`http` — :class:`~orion_tpu.obs.http.ObsHTTPServer`: a
   daemon-thread stdlib HTTP server exposing ``/metrics`` (Prometheus
   text), ``/healthz`` (status code mapped from the health state),
-  ``/statusz`` (human debug page), and ``/slo`` (burn rates + budgets)
-  live, per process — the fleet CLI serves the aggregated view.
+  ``/statusz`` (human debug page), ``/slo`` (burn rates + budgets),
+  ``/costz`` (program cost ledger + capacity), and ``/profilez``
+  (on-demand profiler arming) live, per process — the fleet CLI serves
+  the aggregated view.
+- :mod:`cost` — :class:`~orion_tpu.obs.cost.CostLedger` (per-program
+  flops/bytes/compile-time keyed by the golden-snapshot identity),
+  :func:`~orion_tpu.obs.cost.attribute_chunk` (conservative
+  per-request split of every boundary's measured wall time), and
+  :class:`~orion_tpu.obs.cost.CapacityModel` (live tokens/s ceiling +
+  headroom from the windowed chunk_ms quantiles — the autoscaler's
+  input). ``python -m orion_tpu.obs.cost check`` gates a dumped
+  snapshot on headroom and attribution conservation.
 """
 
+from orion_tpu.obs.cost import CapacityModel, CostLedger, fleet_capacity
 from orion_tpu.obs.flight import FlightRecorder
 from orion_tpu.obs.http import ObsHTTPServer
 from orion_tpu.obs.metrics import MetricsRegistry, aggregate
@@ -49,4 +60,5 @@ __all__ = [
     "MetricsRegistry", "aggregate", "Tracer", "merge_traces",
     "read_jsonl", "span_pairs", "FlightRecorder", "ObsHTTPServer",
     "Objective", "SLOEngine", "quantile_from_counts",
+    "CostLedger", "CapacityModel", "fleet_capacity",
 ]

@@ -19,6 +19,14 @@ backed by a provider callable the owner registers at construction:
   sessions, ladder counters, error budgets, the flight-ring tail).
 - ``/slo`` — ``slo_fn()``'s payload as JSON (burn rates, alerts, error
   budgets — what ``SLOEngine.state()`` returns).
+- ``/costz`` — ``costz_fn()``'s dict rendered as sectioned text (ISSUE
+  15: the program cost ledger, attribution totals, live capacity/
+  headroom); ``/costz.json`` returns the raw payload.
+- ``/profilez?chunks=K`` — ``profilez_fn({"chunks": K})``: arms an
+  on-demand profiler capture for the next K chunk boundaries. The
+  provider only SETS host flags (the serving layer starts/stops the
+  actual profiler on its scheduler thread); a payload carrying a
+  ``"code"`` key sets the HTTP status (409 when disabled/busy).
 
 Contract (enforced by lint): this module is inside ``orion_tpu/obs/``,
 so the ``obs-device-sync`` rule bans any jax reachability or
@@ -77,6 +85,8 @@ class ObsHTTPServer:
         health_fn: Optional[Callable[[], dict]] = None,
         statusz_fn: Optional[Callable[[], dict]] = None,
         slo_fn: Optional[Callable[[], dict]] = None,
+        costz_fn: Optional[Callable[[], dict]] = None,
+        profilez_fn: Optional[Callable[[dict], dict]] = None,
     ):
         self._want_port = port
         self._host = host
@@ -85,6 +95,8 @@ class ObsHTTPServer:
             "health": health_fn,
             "statusz": statusz_fn,
             "slo": slo_fn,
+            "costz": costz_fn,
+            "profilez": profilez_fn,
         }
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -147,6 +159,32 @@ class ObsHTTPServer:
                         f"{name} provider failed: {type(e).__name__}: {e}\n")
             return None, True
 
+    def _call_with(self, handler, name: str, arg):
+        """Like :meth:`_call` but for providers taking one argument
+        (the parsed query dict)."""
+        fn = self._providers.get(name)
+        if fn is None:
+            self._reply(handler, 404, "text/plain",
+                        f"no {name} provider registered\n")
+            return None, True
+        try:
+            return fn(arg), False
+        except Exception as e:
+            self._reply(handler, 500, "text/plain",
+                        f"{name} provider failed: {type(e).__name__}: {e}\n")
+            return None, True
+
+    @staticmethod
+    def _query(handler) -> dict:
+        parts = handler.path.split("?", 1)
+        out = {}
+        if len(parts) == 2:
+            for kv in parts[1].split("&"):
+                if "=" in kv:
+                    k, v = kv.split("=", 1)
+                    out[k] = v
+        return out
+
     def _handle(self, handler) -> None:
         path = handler.path.split("?", 1)[0]
         if path == "/metrics":
@@ -174,10 +212,28 @@ class ObsHTTPServer:
             payload, done = self._call(handler, "slo")
             if not done:
                 self._reply_json(handler, 200, payload)
+        elif path == "/costz":
+            doc, done = self._call(handler, "costz")
+            if not done:
+                self._reply(handler, 200, "text/plain",
+                            _render_statusz(doc))
+        elif path == "/costz.json":
+            doc, done = self._call(handler, "costz")
+            if not done:
+                self._reply_json(handler, 200, doc)
+        elif path == "/profilez":
+            payload, done = self._call_with(
+                handler, "profilez", self._query(handler)
+            )
+            if not done:
+                code = payload.pop("code", 200) if isinstance(
+                    payload, dict
+                ) else 200
+                self._reply_json(handler, code, payload)
         else:
             self._reply(handler, 404, "text/plain",
                         "routes: /metrics /metrics.json /healthz "
-                        "/statusz /slo\n")
+                        "/statusz /slo /costz /profilez?chunks=K\n")
 
     @staticmethod
     def _reply(handler, code, ctype: str, body: str) -> None:

@@ -172,6 +172,26 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="flight-recorder dump directory: the black box "
                         "auto-dumps here on DEGRADED/DRAINING/DEAD, "
                         "ladder exhaustion, and SIGTERM drain")
+    p.add_argument("--no-cost", action="store_true",
+                   help="disable per-request cost attribution + the "
+                        "capacity model (on by default: every result "
+                        "carries its device_ms/flops share, /costz and "
+                        "/statusz report the live tokens/s ceiling and "
+                        "headroom — all host arithmetic at chunk "
+                        "boundaries, zero device syncs)")
+    p.add_argument("--no-cost-ledger", action="store_true",
+                   help="skip the construction-time XLA cost_analysis "
+                        "harvest (one lower-only pass per program, "
+                        "memoized); attribution then weighs by token "
+                        "counts and flops fall back to an analytic "
+                        "2 x params estimate")
+    p.add_argument("--profile-dir", default=None,
+                   help="arm-able on-demand jax.profiler capture: GET "
+                        "/profilez?chunks=K (or Server.arm_profile) "
+                        "records the next K chunk boundaries into one "
+                        "TensorBoard-loadable artifact under this "
+                        "directory — off by default, flight-recorded "
+                        "when triggered")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
@@ -308,6 +328,9 @@ def _run(args, guard) -> int:
             trace_path=args.trace_path, flight_dir=args.flight_dir,
             metrics_port=args.metrics_port, slo=slo_cfg,
             tp=args.tp,
+            cost=not args.no_cost,
+            cost_ledger=not (args.no_cost or args.no_cost_ledger),
+            profile_dir=args.profile_dir,
         ),
     )
     if server.mesh_info is not None:
@@ -320,7 +343,8 @@ def _run(args, guard) -> int:
         )
     if server.http_port is not None:
         print(f"live telemetry: http://127.0.0.1:{server.http_port}"
-              "/metrics | /healthz | /statusz | /slo", file=sys.stderr)
+              "/metrics | /healthz | /statusz | /slo | /costz | "
+              "/profilez?chunks=K", file=sys.stderr)
     if args.session_dir and server.session_store is not None:
         known = server.session_store.list_sessions()
         if known:
@@ -398,6 +422,17 @@ def _run(args, guard) -> int:
         print(f"speculation: {acc} draft(s) accepted, {rej} rejected "
               f"(rate {rate:.3f}), {flat.get('spec_floor_total', 0)} "
               "slot floor(s)", file=sys.stderr)
+    if not args.no_cost:
+        flat = server.metrics.counters_flat()
+        cap = server.capacity.state() if server.capacity else {}
+        line = (f"cost: {flat.get('attributed_ms_total', 0):.1f} ms device "
+                f"time attributed over {flat.get('decode_tokens_total', 0)} "
+                f"decode + {flat.get('prefill_tokens_total', 0)} prefill "
+                "token(s)")
+        if not cap.get("no_data"):
+            line += (f"; capacity ceiling {cap['ceiling_tokens_per_s']} "
+                     f"tok/s, headroom {cap['headroom']:.3f}")
+        print(line, file=sys.stderr)
     if args.prefix_dir:
         flat = server.metrics.counters_flat()
         print(f"prefix cache: {flat.get('prefix_hits', 0)} hit(s), "

@@ -488,6 +488,16 @@ class SlotEngine:
         self._pfold = jnp.zeros((self.slots,), jnp.int32)
         self._pbuf: Optional[Array] = None
         self._done_np = np.ones((self.slots,), bool)
+        # cost attribution (ISSUE 15): per-boundary host report of what
+        # each resident slot DID — work class + token counts, all values
+        # the scheduler already holds. The Server splits the boundary's
+        # measured wall time across these entries (obs/cost.py); rebuilt
+        # at every step(), read immediately after, never on the device.
+        self.last_boundary: List[dict] = []
+        # program kinds whose first launch was timed (the observed
+        # compile time for the cost ledger); unified keys include the
+        # staged-buffer width — a wider bucket is a new program
+        self._compile_seen: set = set()
         if mesh is not None:
             from orion_tpu.parallel.decode import (
                 place_decode_carry,
@@ -952,6 +962,7 @@ class SlotEngine:
         ladder-exhausted failed. Raises nothing for decode-state faults."""
         inject.fire("serve.chunk", step=self._chunk_counter)
         finished: List[Tuple[Any, DecodeResult]] = []
+        self.last_boundary = []
         # deadlines are checked BEFORE paying for the chunk, like the solo
         # session's boundary check
         now = self._clock()
@@ -982,7 +993,22 @@ class SlotEngine:
                 snap, active_dev, active, carry, toks, bad, unified, spec
             )
             for i in sorted(bad):  # ladder exhausted: fail those requests
-                finished.append((self._slots[i].tag, self._finish(i, "failed")))
+                slot = self._slots[i]
+                # the failed slot's boundary work still ran — bill it by
+                # its class so attribution stays conservative. Mid-prefill
+                # failures weigh zero (the host cannot know which replay
+                # fed their piece); nothing was EMITTED either way.
+                self.last_boundary.append({
+                    "slot": i, "tag": slot.tag, "failed": True,
+                    "frozen": spec is None and slot.prompt_remaining > 0,
+                    "spec_round": spec is not None,
+                    "decode_steps": (
+                        0 if spec is not None or slot.prompt_remaining > 0
+                        else self.chunk
+                    ),
+                    "prefill_tokens": 0, "decode_tokens": 0,
+                })
+                finished.append((slot.tag, self._finish(i, "failed")))
                 active[i] = False
         self._carry = carry
         done_np = self._done_np
@@ -1000,6 +1026,11 @@ class SlotEngine:
             if slot.prompt_remaining > 0:
                 slot.chunks += 1
                 if i != sel:
+                    self.last_boundary.append({
+                        "slot": i, "tag": slot.tag, "frozen": True,
+                        "decode_steps": 0, "prefill_tokens": 0,
+                        "decode_tokens": 0,
+                    })
                     continue  # frozen: another slot had the budget
                 consumed = min(piece, slot.prompt_remaining)
                 slot.prompt_remaining -= consumed
@@ -1007,9 +1038,19 @@ class SlotEngine:
                            consumed=consumed,
                            remaining=slot.prompt_remaining)
                 if slot.prompt_remaining > 0:
+                    self.last_boundary.append({
+                        "slot": i, "tag": slot.tag,
+                        "decode_steps": 0, "prefill_tokens": consumed,
+                        "decode_tokens": 0,
+                    })
                     continue  # still mid-prefill: emitted nothing yet
                 slot.toks.append((toks, i, self.chunk))
                 slot.n_emitted += self.chunk
+                self.last_boundary.append({
+                    "slot": i, "tag": slot.tag,
+                    "decode_steps": self.chunk, "prefill_tokens": consumed,
+                    "decode_tokens": self.chunk,
+                })
             elif spec is not None:
                 # speculative round: the probe's accepted row says how
                 # far this slot advanced (accepted drafts + the pending
@@ -1019,6 +1060,11 @@ class SlotEngine:
                 slot.toks.append((toks, i, v))
                 slot.n_emitted += v
                 slot.chunks += 1
+                self.last_boundary.append({
+                    "slot": i, "tag": slot.tag, "spec_round": True,
+                    "decode_steps": 0, "prefill_tokens": 0,
+                    "decode_tokens": v,
+                })
                 if self._spec_on_np[i]:
                     spec_stats["slots"] += 1
                     spec_stats["accepted"] += v - 1
@@ -1028,6 +1074,11 @@ class SlotEngine:
                 slot.toks.append((toks, i, self.chunk))
                 slot.n_emitted += self.chunk
                 slot.chunks += 1
+                self.last_boundary.append({
+                    "slot": i, "tag": slot.tag,
+                    "decode_steps": self.chunk, "prefill_tokens": 0,
+                    "decode_tokens": self.chunk,
+                })
             if slot.n_emitted >= slot.target_new or done_np[i]:
                 finished.append((slot.tag, self._finish(i, "ok")))
         if spec_stats is not None and spec_stats["slots"]:
@@ -1124,6 +1175,25 @@ class SlotEngine:
         (carry, emitted, accepted-or-None). Applies any armed per-slot
         (or legacy per-chunk) decode-state poisoning afterwards so each
         ladder rung is deterministically reachable per slot."""
+        # cost-ledger compile observation: the FIRST launch of each
+        # program kind (per staged-buffer width for the unified program —
+        # a wider bucket is a new executable) is timed against its jit
+        # cache size; growth means this call paid the compile, and the
+        # observed wall time lands in the ledger as that program's
+        # compile cost. One-time host bookkeeping per kind — later
+        # boundaries skip even the cache-size read.
+        kind = ("spec_round" if spec is not None
+                else "unified_prefill" if unified else "decode_batched")
+        seen_key = (
+            (kind, self._pbuf.shape[1]) if kind == "unified_prefill"
+            else kind
+        )
+        watch = None
+        if seen_key not in self._compile_seen:
+            from orion_tpu.generate import DECODE_PROGRAMS
+
+            jf = DECODE_PROGRAMS[kind]
+            watch = (jf, jf._cache_size(), time.monotonic())
         accepted = None
         if spec is not None:
             out, toks, accepted = decode_batched_spec_round(
@@ -1141,6 +1211,12 @@ class SlotEngine:
                 self.model, self.params, carry, self._rngs, active_dev,
                 self.chunk, self._sample,
             )
+        if watch is not None:
+            jf, before, t0 = watch
+            self._compile_seen.add(seen_key)
+            if jf._cache_size() > before:
+                self._emit("program_compile", program=kind,
+                           ms=round((time.monotonic() - t0) * 1e3, 3))
         if inject.active():
             for i, slot in enumerate(self._slots):
                 if slot is None:

@@ -60,6 +60,7 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from orion_tpu.obs import cost as obs_cost
 from orion_tpu.obs import slo as obs_slo
 from orion_tpu.obs.flight import FlightRecorder
 from orion_tpu.obs.http import ObsHTTPServer
@@ -202,6 +203,33 @@ class ServeConfig:
     # (/statusz "mesh" section — a misconfigured mesh is visible before
     # it is slow). Costs one extra AOT compile; tp>1 only.
     mesh_audit: bool = True
+    # -- cost attribution + capacity observability (ISSUE 15; obs/cost.py).
+    # cost=True arms per-request attribution (each boundary's measured
+    # chunk_ms split across resident slots by ledger-weighted work class,
+    # accumulated as device_ms/cost_flops/token counts on every result,
+    # histogram'd at completion) and the live CapacityModel
+    # (capacity_tokens_per_s / capacity_headroom gauges + the /costz and
+    # /statusz sections). Pure host arithmetic at chunk boundaries —
+    # zero device syncs, zero compiles (cache-stat-asserted).
+    cost: bool = True
+    # harvest XLA cost_analysis() flops/bytes for this engine shape's
+    # decode programs at construction (aot.decode_cost_entries —
+    # LOWER-only, the jit caches are untouched; memoized process-wide).
+    # Off by default in the library (a construction-time lowering is a
+    # startup cost unit tests shouldn't pay); the CLIs default it on.
+    # Without it, attribution weights fall back to token counts and
+    # flops to an analytic 2 x params estimate.
+    cost_ledger: bool = False
+    # the CapacityModel's rolling window over chunk_ms / token counters
+    capacity_window_s: float = 30.0
+    # -- on-demand profiling: directory for jax.profiler trace artifacts.
+    # None = /profilez refuses (off by default). Arming (/profilez?
+    # chunks=K or Server.arm_profile) captures the next K chunk
+    # boundaries into one linkable TensorBoard-loadable artifact; the
+    # arm/start/stop walk is flight-recorded. The profiler itself only
+    # ever starts/stops on the scheduler thread at boundaries — never
+    # from the scrape handler.
+    profile_dir: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -221,6 +249,13 @@ class Pending:
     # lifecycle carries (``<session_id>:<seq>`` for session turns, so a
     # resumed conversation links across replicas by prefix)
     rid: str = ""
+    # -- cost-attribution accumulators (ISSUE 15): the scheduler folds
+    # each boundary's attributed share in here; _complete stamps the
+    # totals onto the DecodeResult and _finalize histograms them
+    device_ms: float = 0.0
+    cost_flops: float = 0.0
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
     # called exactly once, right after ``done`` fires — the fleet router
     # ends its root ``turn`` span here; must be host-only and non-raising
     on_done: Optional[Callable[["Pending"], None]] = None
@@ -427,12 +462,7 @@ class Server:
                               lambda: len(self._sessions))
         self.metrics.gauge_fn("sessions_in_slots",
                               lambda: len(self._active_sessions))
-        for label, jitted in (
-            ("decode_batched", _gen._decode_batched_chunk_jit),
-            ("unified_prefill", _gen._decode_batched_prefill_chunk_jit),
-            ("prefill", _gen._prefill_carry_jit),
-            ("prefill_bucketed", _gen._prefill_carry_bucketed_jit),
-        ):
+        for label, jitted in _gen.DECODE_PROGRAMS.items():
             # host-side executable-cache introspection, not a device op —
             # the gauge that proves telemetry added zero compiles. The tp
             # label says which footprint's programs fill the cache (each
@@ -443,6 +473,66 @@ class Server:
                 "compile_cache_entries", jitted._cache_size,
                 labels={"cache": label, "tp": str(self.tp)},
             )
+        # -- cost attribution + capacity (ISSUE 15; obs/cost.py): the
+        # ledger prices this engine shape's programs, attribution splits
+        # every boundary's measured wall time across resident slots, and
+        # the capacity model folds the windowed chunk_ms quantiles into a
+        # live tokens/s ceiling + headroom. All host arithmetic over
+        # values the scheduler already holds.
+        self.cost_enabled = bool(cfg.cost)
+        self.cost_ledger: Optional[obs_cost.CostLedger] = None
+        self.capacity: Optional[obs_cost.CapacityModel] = None
+        if self.cost_enabled:
+            # analytic fallback flops/token (~2 per weight): host-side
+            # metadata over the (possibly quantized) param tree, no sync
+            import jax as _jax
+
+            n_params = sum(
+                int(x.size) for x in _jax.tree.leaves(params)
+            )
+            self.cost_ledger = obs_cost.CostLedger(
+                slots=cfg.slots, chunk=cfg.chunk,
+                prefill_chunk=self.engine.prefill_chunk,
+                spec_depth=cfg.spec_depth,
+                fallback_flops_per_token=2.0 * n_params,
+            )
+            if cfg.cost_ledger:
+                self._harvest_cost_ledger(model)
+            self._h_req_device_ms = self.metrics.histogram(
+                "request_device_ms"
+            )
+            self._h_req_flops = self.metrics.histogram(
+                "request_cost_flops", buckets=obs_cost.FLOPS_BUCKETS
+            )
+            self._c_attr_ms = self.metrics.counter("attributed_ms_total")
+            self._c_decode_tokens = self.metrics.counter(
+                "decode_tokens_total"
+            )
+            self._c_prefill_tokens = self.metrics.counter(
+                "prefill_tokens_total"
+            )
+            self.capacity = obs_cost.CapacityModel(
+                slots=cfg.slots, chunk=cfg.chunk,
+                buckets=self._h_chunk_ms.buckets,
+                read_chunk_counts=self._read_chunk_counts,
+                read_tokens=self._read_device_tokens,
+                clock=clock, window_s=cfg.capacity_window_s,
+            )
+            for field, name in (
+                ("ceiling_tokens_per_s", "capacity_tokens_per_s"),
+                ("current_tokens_per_s", "capacity_current_tokens_per_s"),
+                ("headroom", "capacity_headroom"),
+            ):
+                # lazily-evaluated; RAISES (cell absent) until the model
+                # has data — the check gate's no_data semantics
+                self.metrics.gauge_fn(name, self.capacity.gauge(field))
+        # -- on-demand profiling (ISSUE 15): armed via /profilez or
+        # arm_profile(); the jax.profiler start/stop runs ONLY on the
+        # scheduler thread at chunk boundaries
+        self._profile_pending = 0
+        self._profile_left = 0
+        self._profile_path: Optional[str] = None
+        self._profile_seq = 0
         # durable sessions: write-through disk store + a host-resident LRU
         # cache in front of it (resident entries are ALWAYS also on disk,
         # so idle/LRU eviction is pure cache management, and the race
@@ -506,6 +596,8 @@ class Server:
                 health_fn=self._healthz,
                 statusz_fn=self._statusz,
                 slo_fn=self.slo.state,
+                costz_fn=self._costz,
+                profilez_fn=self._profilez,
             )
             self.http_port = self.http.start()
 
@@ -571,8 +663,236 @@ class Server:
                 "floors_total": flat.get("spec_floor_total", 0),
                 "slots": self.engine.spec_info(),
             }
+        if self.cost_enabled:
+            # the capacity figure an operator (or balancer) wants on the
+            # debug page; the full price sheet stays on /costz
+            flat = self.metrics.counters_flat()
+            snap["cost"] = {
+                "capacity": self.capacity.state(),
+                "attributed_ms_total": round(
+                    flat.get("attributed_ms_total", 0), 3
+                ),
+                "ledger_programs": len(self.cost_ledger.entries()),
+            }
         snap["flight_tail"] = self.flight.events()[-20:]
         return snap
+
+    # -- cost attribution + capacity (ISSUE 15) -------------------------------
+
+    def _harvest_cost_ledger(self, model) -> None:
+        """Price this engine shape's decode programs into the ledger:
+        ``aot.decode_cost_entries`` LOWERS each program (the jit caches
+        are untouched — the zero-compile acceptance covers this) and
+        extracts XLA cost_analysis flops/bytes; the figures land as
+        ``cost_ledger_*`` gauges keyed by the program identity. A failed
+        harvest degrades to the analytic fallback with a warning —
+        serving must come up regardless."""
+        try:
+            from orion_tpu.aot import decode_cost_entries
+
+            entries = decode_cost_entries(
+                model.cfg, slots=self.cfg.slots, chunk=self.cfg.chunk,
+                bucket=max(self.engine.buckets) if self.engine.buckets else 0,
+                prefill_chunk=self.engine.prefill_chunk,
+                qmode=self.qmode, tp=self.tp,
+                spec_depth=self.cfg.spec_depth,
+            )
+        except Exception as e:
+            warnings.warn(
+                f"cost-ledger harvest failed ({type(e).__name__}: {e}); "
+                "attribution falls back to the analytic estimate",
+                stacklevel=2,
+            )
+            return
+        g_flops = self.metrics.gauge("cost_ledger_flops")
+        g_bytes = self.metrics.gauge("cost_ledger_bytes")
+        for e in entries:
+            self.cost_ledger.record(
+                e["kind"], e["key"], flops=e.get("flops"),
+                bytes_accessed=e.get("bytes_accessed"),
+                transcendentals=e.get("transcendentals"),
+                lower_ms=e.get("lower_ms"), error=e.get("error"),
+            )
+            labels = {"program": e["kind"], "key": e["key"]}
+            if e.get("flops") is not None:
+                g_flops.set(e["flops"], labels=labels)
+            if e.get("bytes_accessed") is not None:
+                g_bytes.set(e["bytes_accessed"], labels=labels)
+
+    def _read_chunk_counts(self):
+        """CapacityModel reader: the chunk_ms histogram's label-summed
+        per-bucket counts (tp cells included — the window is over every
+        chunk this server ran)."""
+        cell = self._h_chunk_ms.cell_total()
+        if cell is None:
+            return (0,) * len(self._h_chunk_ms.buckets)
+        return tuple(cell["counts"])
+
+    def _read_device_tokens(self):
+        """CapacityModel reader: cumulative device tokens the boundaries
+        produced (decode + prefill — both are slot-steps of real work)."""
+        flat = self.metrics.counters_flat()
+        return flat.get("decode_tokens_total", 0) + flat.get(
+            "prefill_tokens_total", 0
+        )
+
+    def _attribute_chunk(self, dt_ms: float) -> None:
+        """Split one boundary's measured wall time across the resident
+        slots (obs/cost.py rule; shares sum to exactly ``dt_ms`` —
+        conservation, gated by ``obs.cost check``) and fold each share
+        into its request's accumulators. MUST run before the boundary's
+        finished results are completed so a request's final chunk still
+        lands on its result."""
+        shares = obs_cost.attribute_chunk(
+            self.cost_ledger, dt_ms, self.engine.last_boundary
+        )
+        if not shares:
+            return
+        d_tokens = p_tokens = 0
+        for entry, share_ms, flops in shares:
+            d_tokens += entry.get("decode_tokens", 0)
+            p_tokens += entry.get("prefill_tokens", 0)
+            tag = entry.get("tag")
+            if isinstance(tag, Pending):
+                tag.device_ms += share_ms
+                tag.cost_flops += flops
+                tag.decode_tokens += entry.get("decode_tokens", 0)
+                tag.prefill_tokens += entry.get("prefill_tokens", 0)
+        with self._stats_lock:
+            self._c_attr_ms.inc(dt_ms)
+            if d_tokens:
+                self._c_decode_tokens.inc(d_tokens)
+            if p_tokens:
+                self._c_prefill_tokens.inc(p_tokens)
+
+    def _tick_cost(self) -> None:
+        if self.capacity is not None:
+            self.capacity.tick()
+
+    def _costz(self) -> dict:
+        """/costz payload: the program price sheet, the attribution
+        totals, and the live capacity state — all host dict reads."""
+        out: dict = {"enabled": self.cost_enabled}
+        if not self.cost_enabled:
+            return out
+        flat = self.metrics.counters_flat()
+        out["ledger"] = self.cost_ledger.entries()
+        out["compile_ms"] = self.cost_ledger.compile_times()
+        out["attribution"] = {
+            "attributed_ms_total": round(
+                flat.get("attributed_ms_total", 0), 3
+            ),
+            "decode_tokens_total": flat.get("decode_tokens_total", 0),
+            "prefill_tokens_total": flat.get("prefill_tokens_total", 0),
+            "flops_per_decode_step": self.cost_ledger.flops_per_decode_step(),
+            "flops_per_prefill_token":
+                self.cost_ledger.flops_per_prefill_token(),
+        }
+        if self.cfg.spec_depth:
+            out["attribution"]["flops_per_spec_round"] = (
+                self.cost_ledger.flops_per_spec_round()
+            )
+        out["capacity"] = self.capacity.state()
+        out["profile"] = {
+            "dir": self.cfg.profile_dir,
+            "pending_chunks": self._profile_pending,
+            "active_chunks_left": self._profile_left,
+            "last_artifact": self._profile_path,
+        }
+        return out
+
+    # -- on-demand profiling (ISSUE 15) ---------------------------------------
+
+    def arm_profile(self, chunks: int) -> dict:
+        """Arm a ``jax.profiler`` trace capture for the next ``chunks``
+        chunk boundaries. This only SETS host flags (callable from the
+        /profilez scrape thread); the profiler itself starts and stops
+        on the scheduler thread at boundaries. One capture at a time;
+        refused (409) when disabled or already armed/active."""
+        if not self.cfg.profile_dir:
+            return {"error": "profiling disabled: set ServeConfig."
+                             "profile_dir (--profile-dir)", "code": 409}
+        try:
+            chunks = int(chunks)
+        except (TypeError, ValueError):
+            return {"error": f"bad chunks={chunks!r}", "code": 400}
+        if chunks <= 0:
+            return {"error": f"chunks must be >= 1, got {chunks}",
+                    "code": 400}
+        with self._stats_lock:
+            if self._profile_pending or self._profile_left:
+                return {"error": "a profile capture is already armed or "
+                                 "active", "code": 409}
+            self._profile_pending = chunks
+        self.flight.record("profile", event="armed", chunks=chunks)
+        return {"armed": chunks, "dir": self.cfg.profile_dir}
+
+    def _profilez(self, params: dict) -> dict:
+        # registered as the /profilez provider (banned-sync hook scope):
+        # pure flag-setting — arm_profile owns the str->int parse and
+        # every refusal path, nothing here can touch a device
+        return self.arm_profile(params.get("chunks", 8))
+
+    def _profile_maybe_start(self) -> None:
+        """Scheduler thread, before the boundary's timed window: consume
+        a pending arm and start the capture (the start cost must not be
+        billed as chunk latency; the K profiled chunks' overhead lands
+        in chunk_ms honestly)."""
+        if not self._profile_pending or self._profile_left:
+            return
+        with self._stats_lock:
+            if not self._profile_pending or self._profile_left:
+                return
+            chunks, self._profile_pending = self._profile_pending, 0
+            # reserve the capture BEFORE start_trace returns: arm_profile
+            # checks _profile_left under this lock, so a /profilez racing
+            # the (milliseconds-long) profiler init still gets its 409
+            # instead of silently queueing a second capture
+            self._profile_left = chunks
+        import os as _os
+
+        import jax.profiler as _profiler
+
+        self._profile_seq += 1
+        path = _os.path.join(
+            self.cfg.profile_dir,
+            f"profile-{self._rid_token}-{self._profile_seq}",
+        )
+        try:
+            _os.makedirs(path, exist_ok=True)
+            _profiler.start_trace(path)
+        except Exception as e:
+            with self._stats_lock:
+                self._profile_left = 0  # release the reservation
+            warnings.warn(f"profiler start failed: {e}", stacklevel=2)
+            self.flight.record("profile", event="start_failed",
+                               error=type(e).__name__)
+            return
+        self._profile_path = path
+        self.flight.record("profile", event="start", chunks=chunks,
+                           dir=path)
+
+    def _profile_maybe_stop(self, force: bool = False) -> None:
+        """Scheduler thread, after a boundary (or on drain with
+        ``force`` — a capture must never outlive the loop that armed
+        it): count the boundary down and close the artifact."""
+        if not self._profile_left:
+            return
+        self._profile_left -= 1
+        if self._profile_left > 0 and not force:
+            return
+        self._profile_left = 0
+        import jax.profiler as _profiler
+
+        try:
+            _profiler.stop_trace()
+        except Exception as e:
+            warnings.warn(f"profiler stop failed: {e}", stacklevel=2)
+            self.flight.record("profile", event="stop_failed",
+                               error=type(e).__name__)
+            return
+        self.flight.record("profile", event="stop", dir=self._profile_path,
+                           forced=bool(force))
 
     def _on_health(self, old, new, reason: str) -> None:
         """HealthMachine transition tap (runs AFTER the machine released
@@ -595,6 +915,21 @@ class Server:
         rid = getattr(tag, "rid", None)
         if rid is not None:
             fields["req"] = rid
+        if kind == "program_compile":
+            # the engine observed a jit cache GROW on a program's first
+            # launch: that wall time is the program's compile cost — into
+            # the ledger (the /costz "compile_ms" column) and the black
+            # box (a mid-serve compile is always worth explaining)
+            if self.cost_ledger is not None:
+                self.cost_ledger.note_compile(
+                    fields.get("program", "?"), fields.get("ms", 0.0)
+                )
+                self.metrics.gauge("cost_ledger_compile_ms").set(
+                    fields.get("ms", 0.0),
+                    labels={"program": fields.get("program", "?")},
+                )
+            self.flight.record("program_compile", **fields)
+            return
         if kind == "spec_round":
             # totals every round; the flight ring records only rounds
             # with draft REJECTIONS (each is a rewind-shaped event — the
@@ -762,6 +1097,7 @@ class Server:
                     self._tick_sessions()
                     self._tick_metrics()
                     self._tick_slo()
+                    self._tick_cost()
                     self._admit_from_queue(wd)
                     if (self.prefix_store is not None
                             and self.engine.has_pending_prefixes):
@@ -803,6 +1139,9 @@ class Server:
                     self._reject_leftovers()
                 if wd is not None:
                     wd.close()
+                if self.cfg.profile_dir:
+                    # a capture armed mid-drain must not outlive the loop
+                    self._profile_maybe_stop(force=True)
                 self._guard = None
                 # under the admission lock: once DEAD is published, no
                 # submit can slip a Pending into the dead queue (and any
@@ -1143,6 +1482,8 @@ class Server:
         if wd is not None:
             wd.beat("decode chunk")
         self._maybe_drain(guard)
+        if self.cfg.profile_dir:
+            self._profile_maybe_start()
         occupied = self.engine.active_count
         infos = self.engine.slot_info() if self.trace.enabled else ()
         t0 = self._clock()
@@ -1153,6 +1494,8 @@ class Server:
         # would — the deterministic address for latency-shaped chaos
         fire("serve.chunk_delay", step=self._chunk_seq)
         dt = self._clock() - t0
+        if self.cfg.profile_dir:
+            self._profile_maybe_stop()
         with self._stats_lock:
             self._bump("chunks")
             self._bump("slot_steps_active", occupied)
@@ -1161,6 +1504,12 @@ class Server:
             # separable at the aggregated endpoint (a tp=4 replica's
             # chunks cost collectives a tp=1 replica's don't)
             self._h_chunk_ms.observe(dt * 1e3, labels={"tp": str(self.tp)})
+        if self.cost_enabled:
+            # attribution BEFORE completing the finished results, so a
+            # request's final boundary still lands on its accumulators;
+            # dt*1e3 is the SAME value chunk_ms observed — conservation
+            # is float-exact per boundary by construction
+            self._attribute_chunk(dt * 1e3)
         for i, tag, phase, k in infos:
             self.trace.complete(
                 "decode_chunk" if phase == "decode" else "prefill_piece",
@@ -1182,6 +1531,14 @@ class Server:
             # good on-disk generation — a failed turn must never lock a
             # session out until restart
             self._active_sessions.discard(pending.request.session_id)
+        if self.cost_enabled:
+            # stamp the attribution totals onto the result the caller
+            # sees (shares over this request's boundaries; co-residents'
+            # stamps sum to the measured chunk wall time)
+            result.device_ms = round(pending.device_ms, 6)
+            result.cost_flops = pending.cost_flops
+            result.prefill_tokens = pending.prefill_tokens
+            result.decode_tokens = pending.decode_tokens
         pending.result = result
         self._bump(result.status)
         self._bump("rewinds", result.rewinds)
@@ -1212,6 +1569,7 @@ class Server:
         closes the request's trace span, releases the waiter, and runs
         the ``on_done`` tap (the fleet router's root-span close)."""
         pending.done_at = self._clock()
+        cost_args = {}
         if pending.result is not None:
             # per-turn latency (admission -> release, queue wait
             # included): the SLO engine's primary windowed signal.
@@ -1220,8 +1578,22 @@ class Server:
             self._h_turn_ms.observe(
                 (pending.done_at - pending.admitted_at) * 1e3
             )
+            if self.cost_enabled:
+                # per-request cost at the one place done fires: the
+                # request_device_ms/request_cost_flops histograms (the
+                # SLO engine can window them) and the trace span's args
+                # — Perfetto shows what the turn COST, not just how
+                # long it waited
+                self._h_req_device_ms.observe(pending.device_ms)
+                self._h_req_flops.observe(pending.cost_flops)
+                cost_args = {
+                    "device_ms": round(pending.device_ms, 3),
+                    "cost_flops": round(pending.cost_flops, 1),
+                    "decode_tokens": pending.decode_tokens,
+                    "prefill_tokens": pending.prefill_tokens,
+                }
         self.trace.end("request", pending.rid, status=status,
-                       session=pending.request.session_id)
+                       session=pending.request.session_id, **cost_args)
         pending.done.set()
         cb = pending.on_done
         if cb is not None:
@@ -1279,6 +1651,12 @@ class Server:
             # defaults' burn any more than the server itself sheds on
             # them.
             snap["slo"] = dict(self.slo.state(), actuate=self._slo_actuate)
+            if self.capacity is not None:
+                # the live ceiling/headroom ride the snapshot so the
+                # fleet layer (and the future autoscaler) read them over
+                # the EXISTING status op; state() is the last tick's
+                # payload — no reader runs here
+                snap["capacity"] = self.capacity.state()
             # the full registry rides along so a fleet supervisor can
             # aggregate child registries over the existing status op
             snap["metrics"] = self.metrics.snapshot()

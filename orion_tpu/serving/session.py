@@ -111,6 +111,15 @@ class DecodeResult:
     chunks: int
     rewinds: int = 0
     reprefills: int = 0
+    # -- cost attribution (ISSUE 15; batched Server path only — the solo
+    # DecodeSession reports zeros): this request's share of the measured
+    # chunk wall time (shares across co-residents sum to the boundary's
+    # chunk_ms — conservation), the ledger-derived flops billed, and the
+    # device prefill/decode token counts behind them
+    device_ms: float = 0.0
+    cost_flops: float = 0.0
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
     # the suspended SessionState riding out of the engine for the server
     # to persist before the result is released (durable sessions only)
     session: Any = dataclasses.field(default=None, repr=False, compare=False)

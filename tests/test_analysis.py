@@ -670,6 +670,68 @@ def on_health(old, new, reason):
     )
 
 
+def test_obs_device_sync_covers_cost_surfaces():
+    """ISSUE 15: the cost/capacity hook surfaces are banned-sync scope —
+    the ``costz_fn``/``profilez_fn`` endpoint providers, ``cost_fn``/
+    ``capacity_fn`` callbacks, and any ``*_cost``-named function passed
+    as a callback argument to ANY call (a cost provider by naming
+    contract, whatever registers it). Same bodies unregistered stay
+    un-flagged."""
+    costz = """
+def cost_page(server):
+    return {"flops": float(server.state.sum())}  # syncs per scrape
+
+def wire(http_cls, server):
+    return http_cls(port=0, costz_fn=cost_page)
+"""
+    assert "obs-device-sync" in rule_ids(
+        lint_source(costz, path="orion_tpu/serving/dummy.py")
+    )
+    profilez = """
+def wire(http_cls, engine):
+    return http_cls(port=0, profilez_fn=lambda q: engine.state.item())
+"""
+    assert "obs-device-sync" in rule_ids(
+        lint_source(profilez, path="orion_tpu/serving/dummy.py")
+    )
+    named_cost = """
+def chunk_cost(engine):
+    return float(engine.state.sum())  # device sync in a cost provider
+
+def wire(scheduler):
+    scheduler.register(chunk_cost)  # ANY registration call claims it
+"""
+    assert "obs-device-sync" in rule_ids(
+        lint_source(named_cost, path="orion_tpu/fleet/dummy.py")
+    )
+    clean = """
+def cost_page(server):
+    return {"flops": server.flops_estimate, "ms": server.attributed_ms}
+
+def chunk_cost(engine):
+    return engine.tokens * engine.flops_per_token  # host mirrors only
+
+def wire(http_cls, server, scheduler):
+    scheduler.register(chunk_cost)
+    return http_cls(port=0, costz_fn=cost_page,
+                    capacity_fn=lambda: server.headroom)
+"""
+    assert "obs-device-sync" not in rule_ids(
+        lint_source(clean, path="orion_tpu/serving/dummy.py")
+    )
+    # the identical sync-y bodies NOT registered anywhere stay un-flagged
+    free = """
+def cost_page(server):
+    return {"flops": float(server.state.sum())}
+
+def chunk_cost(engine):
+    return float(engine.state.sum())
+"""
+    assert "obs-device-sync" not in rule_ids(
+        lint_source(free, path="orion_tpu/serving/dummy.py")
+    )
+
+
 def test_obs_device_sync_bans_jax_imports_in_obs_package():
     """Inside orion_tpu/obs/ the jax IMPORT itself is the finding — a
     device array must be structurally unreachable from telemetry code,

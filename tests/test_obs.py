@@ -336,7 +336,10 @@ def test_server_stats_ride_the_registry(mp, tmp_path):
     assert gauges[("slots", (("state", "free"),))] == 2
     caches = [g for g in m["gauges"] if g["name"] == "compile_cache_entries"]
     assert {g["labels"]["cache"] for g in caches} == {
+        # one gauge per entry of generate.DECODE_PROGRAMS (ISSUE 15
+        # made that registry the single naming source)
         "decode_batched", "unified_prefill", "prefill", "prefill_bucketed",
+        "spec_round",
     }
     assert any(g["value"] > 0 for g in caches), "the engine compiled SOMETHING"
     hists = {h["name"]: h for h in m["histograms"]}
@@ -807,6 +810,45 @@ def test_slo_check_cli_gates_a_dumped_snapshot(tmp_path, capsys):
     reg.dump(str(tmp_path / "empty.prom"))
     assert obs_slo.main(["check", "--objectives", obj_path,
                          str(tmp_path / "empty.prom.json")]) == 0
+
+
+def test_slo_check_cli_sums_labelled_chunk_cells(tmp_path, capsys):
+    """Regression (ISSUE 15 satellite): chunk_ms cells carry a ``tp``
+    footprint label since ISSUE 14 — a ``chunk``-source latency
+    objective evaluated from a DUMPED snapshot must sum every label
+    cell (mirroring ``Histogram.cell_total``), not skip or pick one.
+    Pinned both directions: the summed cells pass a threshold the tp=1
+    cell alone would pass, and fail one the tp=2 cell pushes over."""
+    objectives = [{"name": "chunk_p", "kind": "latency",
+                   "latency_ms": 4.0, "source": "chunk", "target": 0.6}]
+    obj_path = str(tmp_path / "obj.json")
+    with open(obj_path, "w") as f:
+        json.dump(objectives, f)
+
+    def dump_registry(slow_tp2):
+        reg = MetricsRegistry()
+        h = reg.histogram("chunk_ms", buckets=(1, 2, 5, 10))
+        for _ in range(8):
+            h.observe(1.5, labels={"tp": "1"})  # all under 4 ms
+        for _ in range(8 if slow_tp2 else 1):
+            h.observe(8.0, labels={"tp": "2"})  # all over 4 ms
+        path = str(tmp_path / "chunk.prom")
+        reg.dump(path)
+        return path + ".json"
+
+    # 8 good + 1 bad across BOTH cells = 89% good: passes 0.6 — and the
+    # events count proves the tp cells were summed, not dropped
+    snap = dump_registry(slow_tp2=False)
+    assert obs_slo.main(["check", "--objectives", obj_path, snap,
+                         "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    row = doc["objectives"][0]
+    assert row["status"] == "ok" and row["events"] == 9
+    # 8 good + 8 bad = 50% good: the tp=2 cell must drag it to violated
+    snap = dump_registry(slow_tp2=True)
+    assert obs_slo.main(["check", "--objectives", obj_path, snap]) == 1
+    out = capsys.readouterr().out
+    assert "violated" in out and "chunk_p" in out
 
 
 # ---------------------------------------------------------------------------
