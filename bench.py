@@ -25,6 +25,7 @@ import argparse
 import dataclasses
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -2262,10 +2263,41 @@ def remat_sweep(iters: int = 8) -> list:
 
 
 
+_CONCURRENCY_PREFLIGHT_DONE = False
+
+
+def _concurrency_preflight() -> None:
+    """Refuse to write a BENCH_SERVE row from a tree with active Tier D
+    findings: a serving number measured on a lock-discipline regression
+    (a wire round-trip under the router lock, an unguarded stats write)
+    is a number about a different — and racy — program. Runs the audit
+    in a subprocess (`--tier concurrency` is a sub-second pure-AST pass)
+    once per bench invocation; the JSON output is surfaced on failure so
+    the offending rule/file/line is in the bench log itself."""
+    global _CONCURRENCY_PREFLIGHT_DONE
+    if _CONCURRENCY_PREFLIGHT_DONE:
+        return
+    proc = subprocess.run(
+        [sys.executable, "-m", "orion_tpu.analysis",
+         "--tier", "concurrency", "--format", "json"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            "concurrency audit preflight failed — fix the findings (or "
+            "baseline them with a rationale) before committing serving "
+            "numbers:\n" + (proc.stdout or proc.stderr)
+        )
+    _CONCURRENCY_PREFLIGHT_DONE = True
+
+
 def _update_bench_serve_row(key: str, res) -> None:
     """Load-modify-atomic-replace one row of BENCH_SERVE.json — the ONE
     definition of the standalone bench flags' write discipline (six
-    flags share it; a divergent copy would silently fork the format)."""
+    flags share it; a divergent copy would silently fork the format).
+    Every row write runs the Tier D concurrency preflight first."""
+    _concurrency_preflight()
     path = os.path.join(os.path.dirname(__file__), "BENCH_SERVE.json")
     doc = {}
     if os.path.exists(path):

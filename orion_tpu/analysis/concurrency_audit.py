@@ -1,0 +1,824 @@
+"""Analysis Tier D — the concurrency auditor for the threaded serving
+stack (``--tier concurrency``).
+
+The declaration lives in :mod:`orion_tpu.serving.locks` (the
+`parallel/budgets.py` idiom: contracts as data, next to the code): lock
+sites + aliases, a partial acquisition ORDER, guarded-by fields, and
+per-lock held-scope bans. This module walks the AST of every module in
+the four threaded packages (`serving/`, `fleet/`, `obs/`,
+`resilience/`) — never importing or executing them — computes an
+interprocedural *held-locks-at-site* summary, and emits five rules:
+
+``lock-order-inversion``
+    acquiring lock A while B is held when the declared order (closed
+    transitively) says A is an OUTER of B — the reversed path is the
+    half of a deadlock cycle the other thread supplies.
+``blocking-under-lock``
+    a call matching a held lock's declared ban category (wire I/O under
+    the router lock, disk/subprocess/sleep under the stats lock, a
+    device sync under any obs lock — the sync set is obs-device-sync's
+    classifier minus the bare float()/int() coercions, which only the
+    obs package itself bans).
+``unguarded-shared-field``
+    a field declared guarded-by L assigned without L held. ``__init__``
+    (and any declared construction-path method) and module-level
+    statements are exempt; matching covers subscript stores
+    (``self._slots[i] = ...``) and tuple-unpacking targets.
+``undeclared-lock``
+    a ``threading.Lock/RLock/Condition`` constructed in an audited
+    module with no matching declaration — the hierarchy cannot rot
+    silently as ROADMAP items add threads.
+``lock-scope-creep``
+    a strict-scope lock (router.lock, watchdog.lock, inject.plan) held
+    across a call the auditor has no summary for: not a builtin, not a
+    CapWords constructor, not a container method, not same-module code,
+    not in the lock's declared ``allow_calls``. Holding a bookkeeping
+    lock across unknown code is how "covers bookkeeping only" rots.
+
+**Held-lock model.** Within a function the walk is statement-ordered:
+``with <lock>:`` scopes push/pop, bare ``.acquire()``/``.release()``
+calls toggle from their statement onward (a conditional acquire is
+over-approximated as held for the rest of the function — lint-grade and
+deliberate). Interprocedurally, every same-module call edge resolvable
+by name (bare names to module/nested defs, ``self.meth`` to same-class
+methods — the `signal-unsafe-handler` closure idiom scaled up) feeds a
+fixpoint: a callee's entry held-set is the union over its call sites of
+the caller's held-set there. Bodies of nested ``def``/``lambda`` are
+excluded from the enclosing scope (they run when *called*, which the
+edge fixpoint models) — so a callback defined under a lock is not
+falsely "under" it. Declared ``decorators`` (batching's
+``@_serialized``) seed the wrapped method's entry set, since the
+``with`` lives in the wrapper's AST, not the method's.
+
+**Lock identity.** An expression maps to a declared node by (module,
+enclosing scope, attr) against the declaration and its aliases; failing
+that, by (module, attr) when unique within the module; failing that, by
+attr when unique across the whole table (this is what lets router code
+name ``replica._state_lock``). The alias list is how the shared Server⇄
+HealthMachine⇄MetricsRegistry RLock stays ONE node. Everything the
+auditor cannot map is simply not tracked — and if it was constructed in
+scope, ``undeclared-lock`` already flagged it.
+
+Findings ride the standard pipeline: ``# orion: noqa[rule-id]``,
+baseline.json rationales, ``--format json``. The auditor never imports
+or executes the audited code — zero traces, compiles, or device syncs —
+and the declaration module is loaded by FILE path, bypassing
+``serving/__init__`` (which imports the whole engine stack), so
+``--tier concurrency`` stays a sub-second pure-AST pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import importlib.util
+import os
+import sys
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from orion_tpu.analysis.findings import BaselineEntry, Finding
+from orion_tpu.analysis.lint import ModuleContext, dotted_name, lint_paths
+from orion_tpu.analysis.rules.obs import _SYNC_ATTRS, _SYNC_DOTTED
+
+RULE_ORDER = "lock-order-inversion"
+RULE_BLOCKING = "blocking-under-lock"
+RULE_UNGUARDED = "unguarded-shared-field"
+RULE_UNDECLARED = "undeclared-lock"
+RULE_CREEP = "lock-scope-creep"
+
+ALL_CONCURRENCY_CHECKS = (
+    RULE_ORDER, RULE_BLOCKING, RULE_UNGUARDED, RULE_UNDECLARED, RULE_CREEP,
+)
+
+# the four packages in Tier D scope (ISSUE 16): everything with a thread
+TIER_D_PACKAGES = (
+    "orion_tpu/serving", "orion_tpu/fleet", "orion_tpu/obs",
+    "orion_tpu/resilience",
+)
+
+# container/primitive methods that cannot transfer control to foreign
+# code — safe under any strict scope (dict/list/deque/set/str/queue/
+# event bookkeeping is exactly what a bookkeeping lock exists for)
+_DATA_METHODS = frozenset({
+    "append", "appendleft", "extend", "pop", "popleft", "clear", "add",
+    "discard", "remove", "insert", "count", "index", "sort", "reverse",
+    "copy", "update", "setdefault", "get", "keys", "values", "items",
+    "join", "split", "rsplit", "strip", "startswith", "endswith",
+    "format", "encode", "decode", "isalnum", "lower", "upper",
+    "qsize", "empty", "full", "put_nowait", "get_nowait",
+    "is_set", "locked", "total_seconds",
+})
+
+# dotted calls safe under any strict scope: host clock reads
+_SAFE_DOTTED = frozenset({
+    "time.monotonic", "time.time", "time.perf_counter",
+    "time.monotonic_ns", "time.perf_counter_ns",
+})
+
+# the repo-wide injectable-clock idiom: ``self._clock()`` is by contract
+# a cheap host time source (time.monotonic or a test's fake)
+_SAFE_SELF_ATTRS = frozenset({"_clock"})
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+# -- declaration loading -------------------------------------------------------
+
+
+class LockTable:
+    """The declaration (serving/locks.py) indexed for AST resolution."""
+
+    def __init__(self, locks: Dict, order, bans: Dict):
+        self.locks = locks
+        self.order = tuple(order)
+        self.bans = bans
+        # (module, scope, attr) -> node; (module, attr) -> nodes;
+        # attr -> nodes
+        self._exact: Dict[Tuple[str, str, str], str] = {}
+        self._by_module_attr: Dict[Tuple[str, str], Set[str]] = {}
+        self._by_attr: Dict[str, Set[str]] = {}
+        self._decorators: Dict[Tuple[str, str], str] = {}
+        for name, decl in locks.items():
+            for site in (decl.site, *decl.aliases):
+                self._exact[(site.module, site.scope, site.attr)] = name
+                self._by_module_attr.setdefault(
+                    (site.module, site.attr), set()
+                ).add(name)
+                self._by_attr.setdefault(site.attr, set()).add(name)
+            for deco in decl.decorators:
+                self._decorators[(decl.site.module, deco)] = name
+        # transitive closure of the declared partial order:
+        # inners[A] = every node A is an OUTER of
+        self.inners: Dict[str, Set[str]] = {}
+        for outer, inner in self.order:
+            self.inners.setdefault(outer, set()).add(inner)
+        changed = True
+        while changed:
+            changed = False
+            for outer, inner_set in list(self.inners.items()):
+                for inner in list(inner_set):
+                    for deeper in self.inners.get(inner, ()):
+                        if deeper not in inner_set:
+                            inner_set.add(deeper)
+                            changed = True
+
+    def decl(self, name: str):
+        return self.locks[name]
+
+    def node_for(self, module: str, scope: str, attr: str) -> Optional[str]:
+        """Resolve a lock-valued expression to a declared node name; see
+        the module docstring for the precedence ladder."""
+        hit = self._exact.get((module, scope, attr))
+        if hit is not None:
+            return hit
+        hits = self._by_module_attr.get((module, attr), ())
+        if len(hits) == 1:
+            return next(iter(hits))
+        hits = self._by_attr.get(attr, ())
+        if len(hits) == 1:
+            return next(iter(hits))
+        return None
+
+    def decorator_lock(self, module: str, deco: str) -> Optional[str]:
+        return self._decorators.get((module, deco))
+
+
+_TABLE: Optional[LockTable] = None
+_LOCKS_MODULE = None
+
+
+def load_locks_module():
+    """Load serving/locks.py by FILE, not package import: the lint pass
+    must stay free of serving/__init__ (which imports the whole engine
+    stack). This is also Tier A's doorway into the declaration — the
+    unbounded-wait rule's obs widened scope reads ``obs_lock_attrs()``
+    from here rather than keeping a second hand-maintained list."""
+    global _LOCKS_MODULE
+    if _LOCKS_MODULE is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "serving", "locks.py",
+        )
+        spec = importlib.util.spec_from_file_location(
+            "_orion_tpu_lock_decls", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        # dataclasses resolves string annotations through sys.modules, so
+        # the file-loaded module must be registered before exec
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)  # type: ignore[union-attr]
+        _LOCKS_MODULE = mod
+    return _LOCKS_MODULE
+
+
+def load_lock_table() -> LockTable:
+    global _TABLE
+    if _TABLE is None:
+        mod = load_locks_module()
+        _TABLE = LockTable(mod.LOCKS, mod.ORDER, mod.BAN_CATEGORIES)
+    return _TABLE
+
+
+# -- the per-module model ------------------------------------------------------
+
+
+def _receiver_parts(node: ast.AST) -> Optional[List[str]]:
+    """``self._registry._lock`` -> ['self', '_registry', '_lock']."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return list(reversed(parts))
+    return None
+
+
+class _FnInfo:
+    def __init__(self, node: ast.AST, scope: str):
+        self.node = node
+        self.scope = scope  # enclosing class/function name, '' = module
+        self.name = node.name  # type: ignore[attr-defined]
+        # events carry the LOCAL held-set; entry_held is unioned in later
+        self.calls: List[Tuple[ast.Call, FrozenSet[str]]] = []
+        self.acquires: List[Tuple[str, int, FrozenSet[str]]] = []
+        self.writes: List[Tuple[str, int, FrozenSet[str]]] = []
+        # resolved same-module call edges: (callee key, held at site)
+        self.edges: List[Tuple[str, FrozenSet[str]]] = []
+        self.entry_held: Set[str] = set()
+
+
+class ConcurrencyModel:
+    """Everything the five rules need for one module, computed once."""
+
+    def __init__(self, ctx: ModuleContext, table: LockTable):
+        self.ctx = ctx
+        self.table = table
+        self.fns: Dict[str, _FnInfo] = {}  # key = f"{scope}.{name}"
+        self._class_methods: Dict[str, Set[str]] = {}
+        self._module_defs: Dict[str, List[str]] = {}  # name -> fn keys
+        self._collect()
+        self._fixpoint()
+
+    # -- collection -----------------------------------------------------------
+
+    def _collect(self) -> None:
+        for node, scope in self._iter_defs(self.ctx.tree, ""):
+            info = _FnInfo(node, scope)
+            key = f"{scope}.{info.name}"
+            # later defs of the same key win nothing; keep the first and
+            # index duplicates under a suffixed key so events survive
+            while key in self.fns:
+                key += "'"
+            self.fns[key] = info
+            self._module_defs.setdefault(info.name, []).append(key)
+            if scope:
+                self._class_methods.setdefault(scope, set()).add(info.name)
+        for info in self.fns.values():
+            held: Set[str] = set()
+            for deco in getattr(info.node, "decorator_list", ()):
+                name = dotted_name(deco)
+                if name is None and isinstance(deco, ast.Call):
+                    name = dotted_name(deco.func)
+                if name:
+                    lock = self.table.decorator_lock(
+                        self.ctx.path, name.rsplit(".", 1)[-1]
+                    )
+                    if lock:
+                        info.entry_held.add(lock)
+            self._walk_block(info.node.body, held, info)  # type: ignore
+
+    def _iter_defs(self, tree: ast.AST, scope: str):
+        """Yield (def node, enclosing scope name) for every function in
+        the module, including methods and nested defs."""
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node, scope
+                yield from self._iter_defs(node, node.name)
+            elif isinstance(node, ast.ClassDef):
+                yield from self._iter_defs(node, node.name)
+            elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For,
+                                   ast.While)):
+                yield from self._iter_defs(node, scope)
+
+    # -- lock expression mapping ----------------------------------------------
+
+    def map_lock(self, expr: ast.AST, scope: str) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self.table.node_for(self.ctx.path, scope, expr.id)
+        parts = _receiver_parts(expr)
+        if not parts:
+            return None
+        attr = parts[-1]
+        if parts[0] == "self" and len(parts) == 2:
+            return self.table.node_for(self.ctx.path, scope, attr)
+        return self.table.node_for(self.ctx.path, "", attr) or (
+            self.table.node_for(self.ctx.path, scope, attr)
+        )
+
+    # -- the statement walk ---------------------------------------------------
+
+    def _iter_calls(self, root: ast.AST) -> Iterator[ast.Call]:
+        """Call nodes of an expression, excluding nested def/lambda
+        bodies (they execute when called, not here)."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_expr(self, expr: ast.AST, held: Set[str],
+                   info: _FnInfo) -> None:
+        """Record call events + resolve edges + apply acquire/release."""
+        for call in self._iter_calls(expr):
+            frozen = frozenset(held)
+            info.calls.append((call, frozen))
+            # same-module edges
+            if isinstance(call.func, ast.Name):
+                for key in self._module_defs.get(call.func.id, ()):
+                    info.edges.append((key, frozen))
+            elif isinstance(call.func, ast.Attribute):
+                parts = _receiver_parts(call.func)
+                if (parts and parts[0] == "self" and len(parts) == 2
+                        and info.scope
+                        and call.func.attr
+                        in self._class_methods.get(info.scope, ())):
+                    for key in self._module_defs.get(call.func.attr, ()):
+                        if self.fns[key].scope == info.scope:
+                            info.edges.append((key, frozen))
+                # bare acquire/release toggles
+                if parts and call.func.attr in ("acquire", "release"):
+                    lock = self.map_lock(call.func.value, info.scope)
+                    if lock is not None:
+                        if call.func.attr == "acquire":
+                            info.acquires.append(
+                                (lock, call.lineno, frozenset(held))
+                            )
+                            held.add(lock)
+                        else:
+                            held.discard(lock)
+
+    def _record_writes(self, target: ast.AST, lineno: int, held: Set[str],
+                       info: _FnInfo) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_writes(elt, lineno, held, info)
+            return
+        base = target
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Attribute):
+            info.writes.append((base.attr, lineno, frozenset(held)))
+        elif isinstance(base, ast.Name) and isinstance(target, ast.Name):
+            # module-global writes (flight.configure's _default swap)
+            info.writes.append((base.id, lineno, frozenset(held)))
+
+    def _walk_block(self, stmts, held: Set[str], info: _FnInfo) -> None:
+        for st in stmts:
+            self._walk_stmt(st, held, info)
+
+    def _walk_stmt(self, st: ast.stmt, held: Set[str],
+                   info: _FnInfo) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # separate scope; edges model when it actually runs
+        if isinstance(st, ast.With):
+            pushed: List[str] = []
+            for item in st.items:
+                self._scan_expr(item.context_expr, held, info)
+                lock = self.map_lock(item.context_expr, info.scope)
+                if lock is not None:
+                    info.acquires.append(
+                        (lock, st.lineno, frozenset(held))
+                    )
+                    if lock not in held:
+                        held.add(lock)
+                        pushed.append(lock)
+            self._walk_block(st.body, held, info)
+            for lock in pushed:
+                held.discard(lock)
+            return
+        if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = getattr(st, "value", None)
+            if value is not None:
+                self._scan_expr(value, held, info)
+            targets = (
+                st.targets if isinstance(st, ast.Assign) else [st.target]
+            )
+            for t in targets:
+                self._record_writes(t, st.lineno, held, info)
+                self._scan_expr(t, held, info)  # subscript index calls
+            return
+        if isinstance(st, ast.Try):
+            self._walk_block(st.body, held, info)
+            for h in st.handlers:
+                self._walk_block(h.body, held, info)
+            self._walk_block(st.orelse, held, info)
+            self._walk_block(st.finalbody, held, info)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._scan_expr(st.test, held, info)
+            self._walk_block(st.body, held, info)
+            self._walk_block(getattr(st, "orelse", []), held, info)
+            return
+        if isinstance(st, ast.For):
+            self._scan_expr(st.iter, held, info)
+            self._walk_block(st.body, held, info)
+            self._walk_block(st.orelse, held, info)
+            return
+        # generic statement: scan every embedded expression
+        for field_val in ast.iter_child_nodes(st):
+            if isinstance(field_val, ast.expr):
+                self._scan_expr(field_val, held, info)
+
+    # -- the interprocedural fixpoint -----------------------------------------
+
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for info in self.fns.values():
+                base = info.entry_held
+                for callee_key, held in info.edges:
+                    callee = self.fns.get(callee_key)
+                    if callee is None:
+                        continue
+                    add = (held | base) - callee.entry_held
+                    if add:
+                        callee.entry_held |= add
+                        changed = True
+
+    # -- event views (entry_held folded in) -----------------------------------
+
+    def iter_acquires(self):
+        for info in self.fns.values():
+            entry = frozenset(info.entry_held)
+            for lock, lineno, held in info.acquires:
+                yield info, lock, lineno, held | entry
+
+    def iter_calls(self):
+        for info in self.fns.values():
+            entry = frozenset(info.entry_held)
+            for call, held in info.calls:
+                yield info, call, held | entry
+
+    def iter_writes(self):
+        for info in self.fns.values():
+            entry = frozenset(info.entry_held)
+            for field, lineno, held in info.writes:
+                yield info, field, lineno, held | entry
+
+    def is_same_module_callee(self, info: _FnInfo, call: ast.Call) -> bool:
+        if isinstance(call.func, ast.Name):
+            return bool(self._module_defs.get(call.func.id))
+        if isinstance(call.func, ast.Attribute):
+            parts = _receiver_parts(call.func)
+            return bool(
+                parts and parts[0] == "self" and len(parts) == 2
+                and info.scope
+                and call.func.attr
+                in self._class_methods.get(info.scope, ())
+            )
+        return False
+
+
+def _model(ctx: ModuleContext, table: LockTable) -> ConcurrencyModel:
+    cached = getattr(ctx, "_orion_concurrency_model", None)
+    if cached is None or cached.table is not table:
+        cached = ConcurrencyModel(ctx, table)
+        ctx._orion_concurrency_model = cached  # type: ignore[attr-defined]
+    return cached
+
+
+# -- ban matching --------------------------------------------------------------
+
+
+def _device_sync_label(call: ast.Call) -> Optional[str]:
+    """obs-device-sync's classifier, minus bare float()/int() coercion
+    (those are only a sync when the operand is a device array, which
+    the obs package bans structurally; under a non-obs lock they are
+    ordinary host arithmetic)."""
+    name = dotted_name(call.func)
+    if name in _SYNC_DOTTED:
+        return f"{name}()"
+    if name and name.split(".", 1)[0] in ("jax", "jnp"):
+        return f"{name}()"
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _SYNC_ATTRS:
+        return f".{call.func.attr}()"
+    return None
+
+
+def _match_ban(ban, call: ast.Call) -> Optional[str]:
+    """The call shape that violates ``ban``, or None."""
+    if ban.classifier == "device_sync":
+        return _device_sync_label(call)
+    name = dotted_name(call.func)
+    if isinstance(call.func, ast.Name) and call.func.id in ban.names:
+        return f"{call.func.id}()"
+    if name:
+        if name in ban.dotted:
+            return f"{name}()"
+        for prefix in ban.dotted_prefixes:
+            if name.startswith(prefix):
+                return f"{name}()"
+    if isinstance(call.func, ast.Attribute) and call.func.attr in ban.attrs:
+        parts = _receiver_parts(call.func)
+        if parts != ["self", call.func.attr]:  # self.submit() = own method
+            return f".{call.func.attr}()"
+    return None
+
+
+# -- the five rules ------------------------------------------------------------
+
+
+class _TierDRule:
+    def __init__(self, table: Optional[LockTable] = None):
+        self._table = table
+
+    @property
+    def table(self) -> LockTable:
+        return self._table if self._table is not None else load_lock_table()
+
+    def _skip(self, ctx: ModuleContext) -> bool:
+        return ctx.is_test
+
+
+class LockOrderInversionRule(_TierDRule):
+    id = RULE_ORDER
+    title = "lock acquired against the declared acquisition order"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self._skip(ctx):
+            return
+        model = _model(ctx, self.table)
+        for info, lock, lineno, held in model.iter_acquires():
+            for other in held:
+                if other == lock:
+                    continue  # reentrant re-acquire, not an inversion
+                if other in self.table.inners.get(lock, ()):
+                    yield Finding(
+                        self.id, ctx.path, lineno,
+                        f"acquires `{lock}` while holding `{other}`, but "
+                        f"the declared order (serving/locks.py ORDER) "
+                        f"makes `{lock}` an outer of `{other}` — this "
+                        "path is one half of a deadlock cycle; take "
+                        f"`{lock}` first or drop the nesting",
+                    )
+
+
+class BlockingUnderLockRule(_TierDRule):
+    id = RULE_BLOCKING
+    title = "banned blocking call in a held-lock scope"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self._skip(ctx):
+            return
+        model = _model(ctx, self.table)
+        for info, call, held in model.iter_calls():
+            if not held:
+                continue
+            for lock in sorted(held):
+                decl = self.table.decl(lock)
+                for cat in decl.bans:
+                    shape = _match_ban(self.table.bans[cat], call)
+                    if shape is None:
+                        continue
+                    yield Finding(
+                        self.id, ctx.path, call.lineno,
+                        f"{shape} while holding `{lock}` violates its "
+                        f"declared `{cat}` ban "
+                        f"({self.table.bans[cat].note.split(';')[0]}) — "
+                        "move the call outside the held scope",
+                    )
+                    break  # one finding per (call, lock)
+
+
+class UnguardedSharedFieldRule(_TierDRule):
+    id = RULE_UNGUARDED
+    title = "declared guarded-by field written without its lock"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self._skip(ctx):
+            return
+        # guards declared for THIS module: field name -> (lock, exempt)
+        guards: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+        for name, decl in self.table.locks.items():
+            for g in decl.guards:
+                if g.module == ctx.path:
+                    for field in g.fields:
+                        guards[field] = (name, decl.guard_exempt)
+        if not guards:
+            return
+        model = _model(ctx, self.table)
+        for info, field, lineno, held in model.iter_writes():
+            hit = guards.get(field)
+            if hit is None:
+                continue
+            lock, exempt = hit
+            if info.name in exempt:
+                continue
+            if lock not in held:
+                yield Finding(
+                    self.id, ctx.path, lineno,
+                    f"`{field}` is declared guarded-by `{lock}` "
+                    f"(serving/locks.py) but `{info.name}` writes it "
+                    "without the lock held — take the lock, or declare "
+                    "the construction path in guard_exempt",
+                )
+
+
+class UndeclaredLockRule(_TierDRule):
+    id = RULE_UNDECLARED
+    title = "lock constructed in scope but absent from the declaration"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self._skip(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name not in (
+                "threading.Lock", "threading.RLock", "threading.Condition"
+            ):
+                continue
+            attr, scope = self._binding(ctx, node)
+            if attr is None:
+                continue
+            if self.table.node_for(ctx.path, scope, attr) is None:
+                yield Finding(
+                    self.id, ctx.path, node.lineno,
+                    f"{name}() bound to `{attr}` has no declaration in "
+                    "serving/locks.py — declare its site, order, guards "
+                    "and held-scope bans (the hierarchy must not rot "
+                    "silently)",
+                )
+
+    @staticmethod
+    def _binding(ctx: ModuleContext,
+                 node: ast.AST) -> Tuple[Optional[str], str]:
+        """The (attr, scope) a lock constructor is bound to: walk up to
+        the nearest enclosing Assign; a ``self.X = threading.Lock()``
+        target belongs to the enclosing CLASS scope, a bare-name target
+        to the enclosing function (or '' at module level)."""
+        assign = getattr(node, "_orion_parent", None)
+        while assign is not None and not isinstance(
+            assign, (ast.Assign, ast.AnnAssign)
+        ):
+            if isinstance(assign, ast.stmt):
+                return None, ""  # not a binding (arg default, call, ...)
+            assign = getattr(assign, "_orion_parent", None)
+        if assign is None:
+            return None, ""
+        targets = (
+            assign.targets if isinstance(assign, ast.Assign)
+            else [assign.target]
+        )
+        target_attr = None
+        self_attr = False
+        for t in targets:
+            if isinstance(t, ast.Attribute):
+                target_attr = t.attr
+                self_attr = isinstance(t.value, ast.Name)
+            elif isinstance(t, ast.Name):
+                target_attr = t.id
+        if target_attr is None:
+            return None, ""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = getattr(cur, "_orion_parent", None)
+            if isinstance(cur, ast.ClassDef):
+                return target_attr, cur.name
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self_attr:
+                    grand = getattr(cur, "_orion_parent", None)
+                    if isinstance(grand, ast.ClassDef):
+                        return target_attr, grand.name
+                return target_attr, cur.name
+        return target_attr, ""
+
+
+class LockScopeCreepRule(_TierDRule):
+    id = RULE_CREEP
+    title = "strict-scope lock held across an unknown call"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self._skip(ctx):
+            return
+        model = _model(ctx, self.table)
+        for info, call, held in model.iter_calls():
+            strict = [
+                lock for lock in sorted(held)
+                if self.table.decl(lock).strict_scope
+            ]
+            if not strict:
+                continue
+            label = self._unknown(model, info, call, strict)
+            if label is None:
+                continue
+            locks = ", ".join(f"`{lock}`" for lock in strict)
+            yield Finding(
+                self.id, ctx.path, call.lineno,
+                f"{label} while holding {locks}: the lock is declared "
+                "strict-scope (bookkeeping only) and the auditor has no "
+                "summary for this call — move it outside the lock, or "
+                "declare it in allow_calls with a rationale",
+            )
+
+    def _unknown(self, model: ConcurrencyModel, info: _FnInfo,
+                 call: ast.Call, strict: List[str]) -> Optional[str]:
+        """A display label when the call is unknown code, else None."""
+        allow: Set[str] = set()
+        for lock in strict:
+            allow.update(self.table.decl(lock).allow_calls)
+        name = dotted_name(call.func)
+        if isinstance(call.func, ast.Name):
+            fn = call.func.id
+            if (fn in _BUILTIN_NAMES or fn in allow
+                    or (fn[:1].isupper())  # CapWords: a constructor
+                    or model.is_same_module_callee(info, call)):
+                return None
+            return f"call to `{fn}`"
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in _DATA_METHODS or attr in allow:
+                return None
+            if name and (name in _SAFE_DOTTED or name in allow):
+                return None
+            parts = _receiver_parts(call.func)
+            if parts and parts[0] == "self" and len(parts) == 2:
+                if attr in _SAFE_SELF_ATTRS:
+                    return None
+                if model.is_same_module_callee(info, call):
+                    return None
+                return f"call to stored callable `self.{attr}`"
+            if attr in ("acquire", "release", "locked", "wait", "wait_for",
+                        "notify", "notify_all"):
+                # ops on a mapped lock/condition are the lock's own
+                # protocol, not foreign code
+                if self.map_lock(call, info, model) is not None:
+                    return None
+            return f"call to `{name or '.' + attr}`"
+        return f"call to `{ast.dump(call.func)[:40]}`"
+
+    @staticmethod
+    def map_lock(call: ast.Call, info: _FnInfo,
+                 model: ConcurrencyModel) -> Optional[str]:
+        if isinstance(call.func, ast.Attribute):
+            return model.map_lock(call.func.value, info.scope)
+        return None
+
+
+def concurrency_rules(table: Optional[LockTable] = None) -> List:
+    return [
+        LockOrderInversionRule(table),
+        BlockingUnderLockRule(table),
+        UnguardedSharedFieldRule(table),
+        UndeclaredLockRule(table),
+        LockScopeCreepRule(table),
+    ]
+
+
+# -- tier entry points ---------------------------------------------------------
+
+
+def audit_concurrency(
+    paths=None,
+    root: str = "",
+    baseline: Tuple[BaselineEntry, ...] = (),
+    keep_suppressed: bool = False,
+    table: Optional[LockTable] = None,
+) -> List[Finding]:
+    """Run Tier D over the four threaded packages (or explicit paths)."""
+    if not root:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    if paths is None:
+        paths = [os.path.join(root, p) for p in TIER_D_PACKAGES]
+    return lint_paths(
+        paths, rules=concurrency_rules(table), baseline=baseline,
+        root=root, keep_suppressed=keep_suppressed,
+    )
+
+
+def audit_source(source: str, path: str,
+                 table: Optional[LockTable] = None) -> List[Finding]:
+    """Tier D over one in-memory module (the test fixture entry point)."""
+    from orion_tpu.analysis.lint import lint_source
+
+    return lint_source(source, path, rules=concurrency_rules(table))
+
+
+__all__ = [
+    "ALL_CONCURRENCY_CHECKS", "ConcurrencyModel", "LockTable",
+    "audit_concurrency", "audit_source", "concurrency_rules",
+    "load_lock_table",
+    "RULE_ORDER", "RULE_BLOCKING", "RULE_UNGUARDED", "RULE_UNDECLARED",
+    "RULE_CREEP",
+]

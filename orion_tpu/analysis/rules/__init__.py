@@ -5,6 +5,12 @@ Adding a rule: write a class with ``id``, ``title``, and
 here (or a new one), append an instance to that module's ``RULES`` list, and
 import the module below. ``tests/test_analysis.py`` expects every registered
 rule to have a positive and a negative fixture.
+
+Tier D's concurrency rules (analysis/concurrency_audit.py) deliberately
+do NOT register here: they run only over the four threaded packages and
+carry their own fixture contract in ``tests/test_concurrency_audit.py``,
+so putting them in ``ALL_RULES`` would both run them on the whole tree
+and break the every-rule-has-a-fixture accounting above.
 """
 
 from __future__ import annotations

@@ -30,7 +30,13 @@ unbounded block there couples the liveness of the /metrics endpoint to
 the liveness of whatever wedged the scheduler — a scrape must return
 or fail, never hang. (``with lock:`` is fine — obs locks are held for
 one snapshot; it is the bare blocking ``acquire()`` call, which can
-carry a timeout and doesn't, that the rule flags.)
+carry a timeout and doesn't, that the rule flags.) WHICH locks the
+``.acquire()`` widening applies to is not this rule's call: the Tier D
+declaration (serving/locks.py, via ``obs_lock_attrs()``) is the single
+source of truth, so only an acquire on a receiver named like a
+declared obs lock is in scope — an ``.acquire()`` on anything else is
+not a spine lock and stays un-flagged, and a new obs lock enters this
+rule's scope the moment it is declared, with no second list to update.
 
 ``signal-unsafe-handler`` — a Python signal handler runs between two
                      arbitrary bytecodes of whatever the main thread was
@@ -58,6 +64,16 @@ from typing import Iterator, List, Set
 
 from orion_tpu.analysis.findings import Finding
 from orion_tpu.analysis.lint import ModuleContext, dotted_name
+
+
+def _obs_lock_attrs():
+    """Attribute names of the locks DECLARED in obs modules
+    (serving/locks.py, the Tier D declaration) — the single source of
+    truth for the obs ``.acquire()`` widening. Imported lazily to keep
+    rule import free of the declaration loader."""
+    from orion_tpu.analysis.concurrency_audit import load_locks_module
+
+    return load_locks_module().obs_lock_attrs()
 
 
 class UnboundedWaitRule:
@@ -104,6 +120,14 @@ class UnboundedWaitRule:
                 continue  # keyword'd non-queue .get()
             if meth in ("join", "wait", "recv", "acquire") and kws:
                 continue  # acquire(blocking=False)/acquire(timeout=...) pass
+            if meth == "acquire":
+                recv = node.func.value
+                rname = (
+                    recv.attr if isinstance(recv, ast.Attribute)
+                    else recv.id if isinstance(recv, ast.Name) else None
+                )
+                if rname not in _obs_lock_attrs():
+                    continue  # not a declared obs lock: out of scope
             yield Finding(
                 self.id, ctx.path, node.lineno,
                 f".{meth}() with no timeout blocks forever if the peer "

@@ -109,9 +109,19 @@ class Router:
         health rank. It deliberately sorts after inflight: a slow idle
         replica still beats a fast saturated one (queueing behind work
         is worse than a slow scan), and the penalty can never starve a
-        replica the fleet actually needs for capacity."""
+        replica the fleet actually needs for capacity.
+
+        Health rank and SLO penalty are read OUTSIDE the router lock:
+        both walk replica-side state (the handle's health machine, its
+        last status snapshot) and the router lock is strict-scope —
+        bookkeeping only, never foreign code. Only the replica-list
+        snapshot itself is taken under the lock; a replica that drains
+        after the snapshot is caught by submit's failover path exactly
+        like one that drains after the pick."""
+        with self._lock:
+            replicas = list(self.replicas)
         out = []
-        for i, r in enumerate(self.replicas):
+        for i, r in enumerate(replicas):
             if not r.routable:
                 continue
             rank = _HEALTH_RANK.get(r.health_state())
@@ -141,6 +151,11 @@ class Router:
         the wire settles."""
         sid = request.session_id
         reservation = None
+        # built ahead of the lock: the Event is the reservation's done
+        # flag and the candidate scan reads replica-side health state —
+        # neither belongs in the strict-scope bookkeeping section
+        turn_done = threading.Event() if sid is not None else None
+        candidates = self._candidates()
         with self._lock:
             if self._dispatches % 256 == 0:
                 # amortized sweep: a conversation that never returns
@@ -168,15 +183,12 @@ class Router:
                         f"fleet admission full ({total} in flight >= "
                         f"max_inflight {self.max_inflight})"
                     )
-            candidates = self._candidates()
             if not candidates:
                 self.stats["rejected"] += 1
                 raise RejectedError("no routable replica in the fleet")
             self._dispatching += 1
             if sid is not None:
-                reservation = FleetPending(
-                    session_id=sid, done=threading.Event()
-                )
+                reservation = FleetPending(session_id=sid, done=turn_done)
                 self._active_sessions[sid] = reservation
             self._turn_seq += 1
             tid = (f"{sid}:{self._turn_seq}" if sid is not None
@@ -297,28 +309,36 @@ class Router:
 
     def snapshot(self) -> dict:
         """Fleet-level gauge payload: per-replica liveness/health/load
-        plus the router's own counters."""
+        plus the router's own counters.
+
+        The router's own bookkeeping (counters, session fence, replica
+        list) is ONE atomic read under the lock; per-replica health is
+        then read outside it — ``health_state()`` is replica-side code
+        and the router lock is strict-scope. The rows are therefore a
+        consistent fleet roster with per-replica fields that may each
+        be a beat newer, which is what a gauge scrape wants anyway."""
         with self._lock:
-            return {
-                "replicas": [
-                    {
-                        "name": r.name,
-                        "alive": r.alive,
-                        "state": r.health_state(),
-                        "inflight": r.inflight,
-                    }
-                    for r in self.replicas
-                ],
-                "inflight": sum(
-                    r.inflight for r in self.replicas if r.alive
-                ),
-                "max_inflight": self.max_inflight,
-                "active_sessions": sum(
-                    1 for p in self._active_sessions.values()
-                    if not p.done.is_set()
-                ),
-                "stats": dict(self.stats),
-            }
+            replicas = list(self.replicas)
+            active = sum(
+                1 for p in self._active_sessions.values()
+                if not p.done.is_set()
+            )
+            stats = dict(self.stats)
+        return {
+            "replicas": [
+                {
+                    "name": r.name,
+                    "alive": r.alive,
+                    "state": r.health_state(),
+                    "inflight": r.inflight,
+                }
+                for r in replicas
+            ],
+            "inflight": sum(r.inflight for r in replicas if r.alive),
+            "max_inflight": self.max_inflight,
+            "active_sessions": active,
+            "stats": stats,
+        }
 
 
 __all__ = ["Router"]

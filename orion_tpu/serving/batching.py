@@ -107,14 +107,21 @@ Array = jax.Array
 # serializes on this process-wide lock (reentrant: entry points nest
 # through the ladder). Unsharded engines never touch it, and in the
 # production shape — one server per process (ProcessReplica children own
-# their devices) — it is simply uncontended.
+# their devices) — it is simply uncontended. Declared as `engine.exec`
+# in serving/locks.py; the Tier D auditor (`--tier concurrency`) checks
+# the engine's slot bookkeeping is only written under it.
 _TP_EXEC_LOCK = threading.RLock()
 
 
 def _serialized(method):
     """Hold the engine's exec guard (the process-wide _TP_EXEC_LOCK for
     mesh engines, a nullcontext otherwise) across a program-launching
-    entry point."""
+    entry point.
+
+    The lock declaration (serving/locks.py `engine.exec`) lists this
+    decorator by name: the `with` lives here in the wrapper, not in the
+    decorated bodies, so the Tier D auditor seeds decorated methods'
+    entry held-set from the declaration instead of seeing the scope."""
 
     @functools.wraps(method)
     def wrapper(self, *args, **kwargs):

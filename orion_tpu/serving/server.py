@@ -875,13 +875,23 @@ class Server:
     def _profile_maybe_stop(self, force: bool = False) -> None:
         """Scheduler thread, after a boundary (or on drain with
         ``force`` — a capture must never outlive the loop that armed
-        it): count the boundary down and close the artifact."""
+        it): count the boundary down and close the artifact.
+
+        The lock-free fast-path read keeps the idle boundary cost at
+        one attribute load; the countdown itself happens under the
+        stats lock (``_profile_left`` is declared guarded-by it) with a
+        re-check, so a concurrent drain and a boundary can never both
+        take the stop path. ``stop_trace`` stays OUTSIDE the lock —
+        same rule as ``start_trace`` on the arm side."""
         if not self._profile_left:
             return
-        self._profile_left -= 1
-        if self._profile_left > 0 and not force:
-            return
-        self._profile_left = 0
+        with self._stats_lock:
+            if not self._profile_left:
+                return  # the other caller already took the countdown
+            self._profile_left -= 1
+            if self._profile_left > 0 and not force:
+                return
+            self._profile_left = 0
         import jax.profiler as _profiler
 
         try:

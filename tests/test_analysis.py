@@ -518,21 +518,26 @@ def test_unbounded_wait_obs_scope_widens_to_acquire_and_wait():
     findings there (ISSUE 10) — a hung scheduler must surface as a
     failed scrape, never a hung /metrics endpoint. Bounded and
     non-blocking forms pass; outside obs/ and fleet/ the widened names
-    stay un-flagged."""
+    stay un-flagged. Which locks the ``.acquire()`` widening covers
+    comes from the Tier D declaration (serving/locks.py
+    ``obs_lock_attrs()``, ISSUE 16) — fixtures name the declared
+    ``_lock`` attribute."""
     bad = """
-def scrape(lock, ev, conn):
-    lock.acquire()
-    ev.wait()
-    return conn.recv()
+class Reg:
+    def scrape(self, ev, conn):
+        self._lock.acquire()
+        ev.wait()
+        return conn.recv()
 """
     clean = """
-def scrape(lock, ev, conn):
-    if not lock.acquire(timeout=1.0):
-        return None
-    got = lock.acquire(blocking=False)
-    ev.wait(timeout=0.5)
-    conn.settimeout(2.0)
-    return conn.recv(4096), got
+class Reg:
+    def scrape(self, ev, conn):
+        if not self._lock.acquire(timeout=1.0):
+            return None
+        got = self._lock.acquire(blocking=False)
+        ev.wait(timeout=0.5)
+        conn.settimeout(2.0)
+        return conn.recv(4096), got
 """
     assert "unbounded-wait" in rule_ids(
         lint_source(bad, path="orion_tpu/obs/http_dummy.py")
@@ -556,6 +561,42 @@ def pump(worker):
 """
     assert "unbounded-wait" in rule_ids(
         lint_source(classic, path="orion_tpu/obs/metrics_dummy.py")
+    )
+
+
+def test_unbounded_wait_obs_acquire_scope_is_the_lock_declaration():
+    """The two directions the rule docstring promises but ISSUE 16 found
+    untested: (a) ``with lock:`` in obs is NOT a finding — the bounded
+    snapshot-hold idiom is the approved shape, only the bare blocking
+    ``acquire()`` call is in scope; (b) the declaration is the source of
+    truth — an ``.acquire()`` on a receiver that is not a declared obs
+    lock (serving/locks.py) is some other object's protocol and stays
+    un-flagged, while the declared ``_default_lock`` module-global is
+    covered without this rule naming it anywhere."""
+    with_stmt = """
+class Reg:
+    def scrape(self):
+        with self._lock:
+            return dict(self._counters)
+"""
+    assert "unbounded-wait" not in rule_ids(
+        lint_source(with_stmt, path="orion_tpu/obs/metrics_dummy.py")
+    )
+    undeclared = """
+def scrape(sem):
+    sem.acquire()
+    return sem
+"""
+    assert "unbounded-wait" not in rule_ids(
+        lint_source(undeclared, path="orion_tpu/obs/http_dummy.py")
+    )
+    declared_global = """
+def configure(rec):
+    _default_lock.acquire()
+    return rec
+"""
+    assert "unbounded-wait" in rule_ids(
+        lint_source(declared_global, path="orion_tpu/obs/flight_dummy.py")
     )
 
 

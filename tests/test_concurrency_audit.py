@@ -1,0 +1,703 @@
+"""Tier D (concurrency audit) — tier-1 gate for ISSUE 16.
+
+Every rule gets a positive (seeded violation) and a negative (clean
+idiom) toy fixture on an INJECTED lock table, so the tests pin the
+analysis semantics without depending on the repo's real declaration;
+assertions are on rule ids and lines, never message text. On top of the
+toy fixtures: the RLock-aliasing one-node case, a two-hop
+interprocedural order inversion, decorator-seeded held scopes
+(batching's ``@_serialized`` shape), baseline/noqa/JSON round-trips, the
+three seeded regressions from the acceptance criteria patched into the
+REAL sources against the REAL declaration, a meta-test that every
+declared lock site resolves to an actual assignment in the declaring
+module (dead declarations can't rot), and the <30s runtime budget."""
+
+import ast
+import json
+import os
+import time
+
+import pytest
+
+from orion_tpu.analysis.concurrency_audit import (
+    LockTable,
+    RULE_BLOCKING,
+    RULE_CREEP,
+    RULE_ORDER,
+    RULE_UNDECLARED,
+    RULE_UNGUARDED,
+    audit_concurrency,
+    audit_source,
+    load_lock_table,
+    load_locks_module,
+)
+from orion_tpu.analysis.findings import BaselineEntry
+
+pytestmark = pytest.mark.analysis
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MOD = "pkg/svc.py"
+
+L = load_locks_module()
+
+
+def rule_ids(findings):
+    return {f.rule for f in findings}
+
+
+def _decl(name, attr, scope="C", module=MOD, **kw):
+    return L.LockDecl(
+        name=name, site=L.LockSite(module, scope, attr), kind="Lock",
+        note="toy", **kw,
+    )
+
+
+def _table(locks, order=()):
+    return LockTable({d.name: d for d in locks}, order, L.BAN_CATEGORIES)
+
+
+def _line_of(source, needle):
+    for i, line in enumerate(source.splitlines(), start=1):
+        if needle in line:
+            return i
+    raise AssertionError(f"{needle!r} not in source")
+
+
+# ---------------------------------------------------------------------------
+# lock-order-inversion
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_inversion_both_directions():
+    table = _table([_decl("a", "_a"), _decl("b", "_b")], [("a", "b")])
+    bad = """
+class C:
+    def f(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+    fs = audit_source(bad, MOD, table)
+    assert RULE_ORDER in rule_ids(fs)
+    (f,) = [f for f in fs if f.rule == RULE_ORDER]
+    assert f.line == _line_of(bad, "with self._a:")
+    good = """
+class C:
+    def f(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+    assert RULE_ORDER not in rule_ids(audit_source(good, MOD, table))
+
+
+def test_lock_order_inversion_two_hop_interprocedural():
+    """f holds the inner lock and calls g, g calls h, h takes the outer:
+    the held set must flow through BOTH same-module edges to reach the
+    acquisition site."""
+    table = _table([_decl("a", "_a"), _decl("b", "_b")], [("a", "b")])
+    bad = """
+class C:
+    def f(self):
+        with self._b:
+            self.g()
+
+    def g(self):
+        self.h()
+
+    def h(self):
+        with self._a:
+            pass
+"""
+    fs = [f for f in audit_source(bad, MOD, table) if f.rule == RULE_ORDER]
+    assert len(fs) == 1
+    assert fs[0].line == _line_of(bad, "with self._a:")
+    # same chain in the declared direction is clean
+    good = bad.replace("self._b", "_tmp_").replace(
+        "self._a", "self._b"
+    ).replace("_tmp_", "self._a")
+    assert RULE_ORDER not in rule_ids(audit_source(good, MOD, table))
+
+
+def test_order_closure_is_transitive():
+    """A declared a<b, b<c chain makes acquiring a under c an inversion
+    without a direct (a, c) entry."""
+    table = _table(
+        [_decl("a", "_a"), _decl("b", "_b"), _decl("c", "_c")],
+        [("a", "b"), ("b", "c")],
+    )
+    bad = """
+class C:
+    def f(self):
+        with self._c:
+            with self._a:
+                pass
+"""
+    assert RULE_ORDER in rule_ids(audit_source(bad, MOD, table))
+
+
+def test_reentrant_reacquire_is_not_an_inversion():
+    table = _table([_decl("a", "_a")], [])
+    src = """
+class C:
+    def f(self):
+        with self._a:
+            with self._a:
+                pass
+"""
+    assert RULE_ORDER not in rule_ids(audit_source(src, MOD, table))
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_under_lock_both_directions():
+    table = _table([_decl("a", "_a", bans=("sleep",))])
+    bad = """
+import time
+
+class C:
+    def f(self):
+        with self._a:
+            time.sleep(0.5)
+"""
+    fs = [f for f in audit_source(bad, MOD, table)
+          if f.rule == RULE_BLOCKING]
+    assert len(fs) == 1 and fs[0].line == _line_of(bad, "time.sleep")
+    good = """
+import time
+
+class C:
+    def f(self):
+        with self._a:
+            x = 1
+        time.sleep(0.5)
+        return x
+"""
+    assert RULE_BLOCKING not in rule_ids(audit_source(good, MOD, table))
+
+
+def test_blocking_under_lock_wire_attr_skips_self_receiver():
+    """The wire ban's ``attrs`` match only non-self receivers: calling a
+    replica handle's ``.submit()`` under the lock is the violation; a
+    method of the SAME object that happens to be named submit is not a
+    wire round-trip."""
+    table = _table([_decl("a", "_a", bans=("wire",))])
+    bad = """
+class C:
+    def f(self, replica, req):
+        with self._a:
+            return replica.submit(req)
+"""
+    assert RULE_BLOCKING in rule_ids(audit_source(bad, MOD, table))
+    own = """
+class C:
+    def submit(self, req):
+        return req
+
+    def f(self, req):
+        with self._a:
+            return self.submit(req)
+"""
+    assert RULE_BLOCKING not in rule_ids(audit_source(own, MOD, table))
+
+
+def test_blocking_under_lock_device_sync_classifier():
+    """The ``device-sync`` category is matched by the obs sync
+    classifier (block_until_ready / jax.device_get / jnp.*), not by name
+    lists in the declaration."""
+    table = _table([_decl("a", "_a", bans=("device-sync",))])
+    bad = """
+class C:
+    def f(self, x):
+        with self._a:
+            return x.block_until_ready()
+"""
+    assert RULE_BLOCKING in rule_ids(audit_source(bad, MOD, table))
+    bad2 = """
+import jax
+
+class C:
+    def f(self, x):
+        with self._a:
+            return jax.device_get(x)
+"""
+    assert RULE_BLOCKING in rule_ids(audit_source(bad2, MOD, table))
+    good = """
+class C:
+    def f(self, x):
+        y = x.block_until_ready()
+        with self._a:
+            self._y = y
+        return y
+"""
+    assert RULE_BLOCKING not in rule_ids(audit_source(good, MOD, table))
+
+
+def test_blocking_under_lock_flows_into_helpers():
+    """A helper reachable only from under the lock inherits the held
+    set: the sleep hides one call away."""
+    table = _table([_decl("a", "_a", bans=("sleep",))])
+    bad = """
+import time
+
+class C:
+    def f(self):
+        with self._a:
+            self._retry()
+
+    def _retry(self):
+        time.sleep(1.0)
+"""
+    fs = [f for f in audit_source(bad, MOD, table)
+          if f.rule == RULE_BLOCKING]
+    assert len(fs) == 1 and fs[0].line == _line_of(bad, "time.sleep")
+
+
+# ---------------------------------------------------------------------------
+# unguarded-shared-field
+# ---------------------------------------------------------------------------
+
+
+def test_unguarded_shared_field_both_directions():
+    table = _table([_decl(
+        "a", "_a", guards=(L.GuardedField(MOD, "C", ("_x",)),),
+    )])
+    bad = """
+class C:
+    def __init__(self):
+        self._x = 0
+
+    def f(self):
+        self._x = 1
+"""
+    fs = [f for f in audit_source(bad, MOD, table)
+          if f.rule == RULE_UNGUARDED]
+    # __init__ is construction-exempt; only f() fires
+    assert len(fs) == 1 and fs[0].line == _line_of(bad, "self._x = 1")
+    good = """
+class C:
+    def __init__(self):
+        self._x = 0
+
+    def f(self):
+        with self._a:
+            self._x = 1
+"""
+    assert RULE_UNGUARDED not in rule_ids(audit_source(good, MOD, table))
+
+
+def test_unguarded_shared_field_subscript_and_augassign():
+    table = _table([_decl(
+        "a", "_a", guards=(L.GuardedField(MOD, "C", ("_slots", "_n")),),
+    )])
+    bad = """
+class C:
+    def f(self, i):
+        self._slots[i] = None
+        self._n += 1
+"""
+    fs = [f for f in audit_source(bad, MOD, table)
+          if f.rule == RULE_UNGUARDED]
+    assert {f.line for f in fs} == {
+        _line_of(bad, "self._slots[i]"), _line_of(bad, "self._n += 1"),
+    }
+
+
+def test_decorator_seeded_held_scope():
+    """batching's ``@_serialized`` shape: the lock lives in the wrapper,
+    so the declaration's ``decorators`` seeds the wrapped method's entry
+    held-set — and it propagates into helpers the method calls. An
+    undecorated, uncalled method still fires."""
+    table = _table([_decl(
+        "e", "_exec_lock", scope="Eng", decorators=("_serialized",),
+        guards=(L.GuardedField(MOD, "Eng", ("_slots",)),),
+    )])
+    src = """
+class Eng:
+    @_serialized
+    def step(self):
+        self._slots = []
+        self._finish()
+
+    def _finish(self):
+        self._slots = None
+
+    def rogue(self):
+        self._slots = 1
+"""
+    fs = [f for f in audit_source(src, MOD, table)
+          if f.rule == RULE_UNGUARDED]
+    assert len(fs) == 1 and fs[0].line == _line_of(src, "self._slots = 1")
+
+
+# ---------------------------------------------------------------------------
+# undeclared-lock
+# ---------------------------------------------------------------------------
+
+
+def test_undeclared_lock_both_directions():
+    table = _table([_decl("a", "_a")])
+    bad = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._mystery = threading.Lock()
+"""
+    fs = [f for f in audit_source(bad, MOD, table)
+          if f.rule == RULE_UNDECLARED]
+    assert len(fs) == 1
+    assert fs[0].line == _line_of(bad, "_mystery")
+    good = bad.replace("        self._mystery = threading.Lock()\n", "")
+    assert RULE_UNDECLARED not in rule_ids(audit_source(good, MOD, table))
+
+
+def test_undeclared_lock_module_level_and_condition():
+    table = _table([_decl("g", "_global_lock", scope="", )])
+    src = """
+import threading
+
+_global_lock = threading.Lock()
+_rogue_cv = threading.Condition()
+"""
+    fs = [f for f in audit_source(src, MOD, table)
+          if f.rule == RULE_UNDECLARED]
+    assert len(fs) == 1 and fs[0].line == _line_of(src, "_rogue_cv")
+
+
+# ---------------------------------------------------------------------------
+# lock-scope-creep
+# ---------------------------------------------------------------------------
+
+
+def test_lock_scope_creep_both_directions():
+    table = _table([_decl("s", "_s", strict_scope=True)])
+    bad = """
+class C:
+    def f(self, replica):
+        with self._s:
+            replica.frob_state()
+"""
+    fs = [f for f in audit_source(bad, MOD, table) if f.rule == RULE_CREEP]
+    assert len(fs) == 1 and fs[0].line == _line_of(bad, "frob_state")
+    # builtins, CapWords constructors, container methods, same-class
+    # methods, and the injectable clock are all known-safe shapes
+    good = """
+class C:
+    def f(self, out):
+        with self._s:
+            n = len(out)
+            out.append(ValueError("x"))
+            self._bump()
+            t = self._clock()
+        return n, t
+
+    def _bump(self):
+        pass
+"""
+    assert RULE_CREEP not in rule_ids(audit_source(good, MOD, table))
+
+
+def test_lock_scope_creep_allow_calls_escape_hatch():
+    table = _table([_decl(
+        "s", "_s", strict_scope=True, allow_calls=("replica.frob_state",),
+    )])
+    src = """
+class C:
+    def f(self, replica):
+        with self._s:
+            replica.frob_state()
+"""
+    assert RULE_CREEP not in rule_ids(audit_source(src, MOD, table))
+
+
+def test_non_strict_lock_allows_unknown_calls():
+    table = _table([_decl("a", "_a")])
+    src = """
+class C:
+    def f(self, replica):
+        with self._a:
+            replica.frob_state()
+"""
+    assert RULE_CREEP not in rule_ids(audit_source(src, MOD, table))
+
+
+# ---------------------------------------------------------------------------
+# RLock aliasing: the shared Server/Health/Registry lock is ONE node
+# ---------------------------------------------------------------------------
+
+
+def test_rlock_aliasing_is_one_node():
+    """Two classes share one RLock through injection (the Server⇄
+    HealthMachine design): a field declared guarded on one class's scope
+    is satisfied when the OTHER class's alias attribute is held, and
+    taking the alias while holding the primary is a reentrant
+    re-acquire, never an inversion."""
+    shared = L.LockDecl(
+        name="shared",
+        site=L.LockSite(MOD, "Server", "_stats_lock"),
+        kind="RLock", note="toy",
+        aliases=(L.LockSite(MOD, "Health", "_lock"),),
+        guards=(L.GuardedField(MOD, "Health", ("_state",)),),
+    )
+    table = _table([shared])
+    good = """
+class Health:
+    def to(self, new):
+        with self._lock:
+            self._state = new
+"""
+    assert RULE_UNGUARDED not in rule_ids(audit_source(good, MOD, table))
+    bad = """
+class Health:
+    def to(self, new):
+        self._state = new
+"""
+    assert RULE_UNGUARDED in rule_ids(audit_source(bad, MOD, table))
+    # primary-then-alias is a reentrant acquire of the same node
+    reenter = """
+class Server:
+    def snapshot(self, health):
+        with self._stats_lock:
+            with health._lock:
+                return 1
+"""
+    assert RULE_ORDER not in rule_ids(audit_source(reenter, MOD, table))
+
+
+# ---------------------------------------------------------------------------
+# pipeline round-trips: noqa, baseline, JSON
+# ---------------------------------------------------------------------------
+
+
+def test_noqa_suppresses_tier_d_finding():
+    table = _table([_decl("a", "_a"), _decl("b", "_b")], [("a", "b")])
+    src = """
+class C:
+    def f(self):
+        with self._b:
+            with self._a:  # orion: noqa[lock-order-inversion]
+                pass
+"""
+    assert RULE_ORDER not in rule_ids(audit_source(src, MOD, table))
+
+
+def test_baseline_round_trip(tmp_path):
+    table = _table([_decl("a", "_a", module="pkg/svc.py")])
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "svc.py").write_text("""
+import threading
+
+class C:
+    def __init__(self):
+        self._rogue = threading.Lock()
+""")
+    fs = audit_concurrency(
+        paths=[str(pkg)], root=str(tmp_path), table=table,
+    )
+    assert rule_ids(fs) == {RULE_UNDECLARED}
+    baselined = audit_concurrency(
+        paths=[str(pkg)], root=str(tmp_path), table=table,
+        baseline=(BaselineEntry(
+            RULE_UNDECLARED, "pkg/svc.py", "toy: deliberate"
+        ),),
+    )
+    assert baselined == []
+    kept = audit_concurrency(
+        paths=[str(pkg)], root=str(tmp_path), table=table,
+        baseline=(BaselineEntry(
+            RULE_UNDECLARED, "pkg/svc.py", "toy: deliberate"
+        ),),
+        keep_suppressed=True,
+    )
+    assert [f.status for f in kept] == ["baselined"]
+
+
+def test_cli_json_round_trip(capsys):
+    """``--tier concurrency --format json`` exits 0 on the repaired tree
+    and emits the standard findings document."""
+    from orion_tpu.analysis.__main__ import main
+
+    rc = main(["--tier", "concurrency", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["tier"] == "concurrency"
+    assert doc["counts"]["active"] == 0
+    for f in doc["findings"]:
+        assert {"rule", "path", "line", "message", "status"} <= set(f)
+
+
+# ---------------------------------------------------------------------------
+# the three seeded regressions from the acceptance criteria, against the
+# REAL sources and the REAL declaration
+# ---------------------------------------------------------------------------
+
+
+def _read(rel):
+    with open(os.path.join(ROOT, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+def test_seeded_reversed_two_lock_acquisition_in_router():
+    src = _read("orion_tpu/fleet/router.py")
+    old = (
+        "                    with self._lock:\n"
+        "                        self.stats[\"failovers\"] += 1"
+    )
+    assert old in src, "failover-path anchor moved; update the fixture"
+    new = (
+        "                    with replica._state_lock:\n"
+        "                        with self._lock:\n"
+        "                            self.stats[\"failovers\"] += 1"
+    )
+    patched = src.replace(old, new, 1)
+    fs = [f for f in audit_source(patched, "orion_tpu/fleet/router.py")
+          if f.rule == RULE_ORDER]
+    assert len(fs) == 1
+    assert fs[0].path == "orion_tpu/fleet/router.py"
+    # the inversion is reported at the router-lock acquisition nested
+    # inside the seeded replica-lock scope: one line below the marker
+    assert fs[0].line == _line_of(patched, "with replica._state_lock:") + 1
+
+
+def test_seeded_replica_submit_under_router_lock():
+    src = _read("orion_tpu/fleet/router.py")
+    old = (
+        "                    try:\n"
+        "                        pending = replica.submit(request)"
+    )
+    assert old in src, "dispatch anchor moved; update the fixture"
+    new = (
+        "                    try:\n"
+        "                        with self._lock:\n"
+        "                            pending = replica.submit(request)"
+    )
+    patched = src.replace(old, new, 1)
+    fs = [f for f in audit_source(patched, "orion_tpu/fleet/router.py")
+          if f.rule == RULE_BLOCKING]
+    assert len(fs) == 1
+    assert fs[0].line == _line_of(
+        patched, "pending = replica.submit(request)"
+    )
+    # the wire round-trip under a strict-scope lock is also scope creep
+    assert RULE_CREEP in rule_ids(
+        audit_source(patched, "orion_tpu/fleet/router.py")
+    )
+
+
+def test_seeded_lock_free_write_to_guarded_server_field():
+    src = _read("orion_tpu/serving/server.py")
+    anchor = "    def _profile_maybe_stop("
+    assert anchor in src
+    patched = src.replace(
+        anchor,
+        "    def _poke_profile(self):\n"
+        "        self._profile_pending = 0\n\n" + anchor,
+        1,
+    )
+    fs = [f for f in audit_source(patched, "orion_tpu/serving/server.py")
+          if f.rule == RULE_UNGUARDED]
+    assert len(fs) == 1
+    assert fs[0].path == "orion_tpu/serving/server.py"
+    # the write is the line after the injected def (the real file has
+    # other, locked writes of the same field — anchor on the method)
+    assert fs[0].line == _line_of(patched, "def _poke_profile") + 1
+
+
+def test_repaired_tree_is_clean():
+    """The acceptance gate: zero active Tier D findings on the repo."""
+    assert audit_concurrency(root=ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# declaration hygiene: dead declarations can't rot
+# ---------------------------------------------------------------------------
+
+
+def _assigned_names(node):
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                sub.targets if isinstance(sub, ast.Assign)
+                else [sub.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    out.add(t.attr)
+    return out
+
+
+def test_every_declared_lock_site_resolves():
+    """Every site and alias in serving/locks.py must be a real
+    assignment in the declaring module at the declared scope — a renamed
+    attribute or class breaks THIS test, not silently the audit."""
+    table = load_lock_table()
+    for name, decl in table.locks.items():
+        for site in (decl.site, *decl.aliases):
+            path = os.path.join(ROOT, site.module)
+            assert os.path.exists(path), f"{name}: no module {site.module}"
+            tree = ast.parse(_read(site.module))
+            if site.scope == "":
+                attrs = set()
+                for st in tree.body:
+                    attrs |= (
+                        _assigned_names(st)
+                        if isinstance(st, (ast.Assign, ast.AnnAssign))
+                        else set()
+                    )
+            else:
+                owner = next(
+                    (
+                        n for n in ast.walk(tree)
+                        if isinstance(
+                            n,
+                            (ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef),
+                        ) and n.name == site.scope
+                    ),
+                    None,
+                )
+                assert owner is not None, (
+                    f"{name}: no scope {site.scope} in {site.module}"
+                )
+                attrs = _assigned_names(owner)
+            assert site.attr in attrs, (
+                f"{name}: {site.module}:{site.scope} never assigns "
+                f"{site.attr} — dead declaration"
+            )
+
+
+def test_every_declared_guarded_field_resolves():
+    """Same hygiene for guards: a guarded field that no code in the
+    declaring module ever assigns is a typo, not a contract."""
+    table = load_lock_table()
+    for name, decl in table.locks.items():
+        for g in decl.guards:
+            tree = ast.parse(_read(g.module))
+            assigned = _assigned_names(tree)
+            for field in g.fields:
+                assert field in assigned, (
+                    f"{name}: guard {field} never assigned in {g.module}"
+                )
+
+
+# ---------------------------------------------------------------------------
+# runtime budget
+# ---------------------------------------------------------------------------
+
+
+def test_tier_d_stays_under_thirty_seconds():
+    """ISSUE 16's --tier all budget: Tier D alone must stay well inside
+    the 870s tier-1 gate — <30s on the whole repo (it is a pure AST
+    pass; in practice sub-second)."""
+    t0 = time.perf_counter()
+    audit_concurrency(root=ROOT)
+    assert time.perf_counter() - t0 < 30.0
