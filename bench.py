@@ -1313,6 +1313,196 @@ def bench_shared_prefix(prefix_len: int = 1024, n_requests: int = 64,
     return out
 
 
+def bench_store_outage(n_sessions: int = 24, n_prefix: int = 8,
+                       prefix_len: int = 256, suffix_len: int = 8,
+                       max_new: int = 32, slots: int = 4, chunk: int = 4,
+                       prefill_chunk: int = 32,
+                       config: str = "tiny") -> dict:
+    """Store-outage degradation row (ISSUE 17): the same two-phase trace
+    served twice — healthy, then with phase B under a 100% outage of
+    BOTH shared stores (session ``eio`` + prefix ``partition``).
+
+    Phase A (always healthy, untimed) lands every session's first turn
+    and publishes the shared prefix — the residency and cache state a
+    warm replica carries into an outage. Phase B (the scored window) is
+    every session's SECOND turn plus fresh shared-prefix arrivals; in
+    the degraded pass the whole phase runs inside the regime, so session
+    continuations serve from resident copies (write-behind dirty pins
+    behind the breaker) and prefix lookups degrade to cold in-scan
+    prefill. The row scores what the outage COSTS (phase-B tokens/s vs
+    the healthy pass) and what it must NOT cost: zero failed and zero
+    shed requests — the availability/error-rate SLO gate runs on the
+    outage pass's registry snapshot so a pass that dropped work cannot
+    land as a bench row. Also reports the recovery tail: seconds of
+    post-outage serve loop until every dirty session drained and both
+    breakers closed."""
+    import dataclasses as _dc
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orion_tpu.generate import SampleConfig
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.obs import slo as obs_slo
+    from orion_tpu.resilience import inject
+    from orion_tpu.serving import DecodeRequest, ServeConfig, Server
+
+    cfg = _dc.replace(
+        get_config(config),
+        max_seq_len=max(
+            512, prefix_len + suffix_len + 2 * max_new + chunk
+        ),
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(17)
+    prefix = rng.integers(0, cfg.vocab_size, (1, prefix_len), dtype=np.int32)
+    turn1 = [
+        rng.integers(0, cfg.vocab_size, (1, suffix_len), dtype=np.int32)
+        for _ in range(n_sessions)
+    ]
+    fresh = [
+        rng.integers(0, cfg.vocab_size, (1, suffix_len), dtype=np.int32)
+        for _ in range(n_prefix)
+    ]
+    sample = SampleConfig(temperature=0.0)
+
+    def one_pass(root, outage):
+        server = Server(model, params, ServeConfig(
+            chunk=chunk, slots=slots,
+            max_inflight=n_sessions + n_prefix,
+            prefill_chunk=prefill_chunk,
+            prefix_dir=os.path.join(root, "prefix"),
+            session_dir=os.path.join(root, "sessions"),
+            params_id="bench-store-outage",
+            breaker_failures=1, breaker_backoff=0.05,
+            breaker_max_backoff=0.1, max_dirty_sessions=n_sessions,
+        ))
+        try:
+            # phase A: first turns + the shared-prefix publish, healthy
+            for i, sfx in enumerate(turn1):
+                prompt = np.concatenate([prefix, sfx], axis=1)
+                server.submit(DecodeRequest(
+                    prompt=prompt, max_new_tokens=max_new, sample=sample,
+                    seed=i, prefix_len=prefix.shape[1],
+                    session_id=f"user{i}",
+                ))
+            rc_a = server.serve(drain_when_idle=True)
+            # phase B: second turns + fresh prefix arrivals — the whole
+            # phase inside the regime in the degraded pass
+            plan = None
+            if outage:
+                plan = (
+                    inject.FaultPlan()
+                    .degrade_site("serve.session_", kind="eio")
+                    .degrade_site("serve.prefix_", kind="partition")
+                )
+            pendings = []
+            t0 = time.monotonic()
+
+            def phase_b():
+                for i in range(n_sessions):
+                    pendings.append(server.submit(DecodeRequest(
+                        prompt=np.zeros((1, 0), np.int32),
+                        max_new_tokens=max_new, sample=sample,
+                        seed=1000 + i, session_id=f"user{i}",
+                    )))
+                for j, sfx in enumerate(fresh):
+                    prompt = np.concatenate([prefix, sfx], axis=1)
+                    pendings.append(server.submit(DecodeRequest(
+                        prompt=prompt, max_new_tokens=max_new,
+                        sample=sample, seed=2000 + j,
+                        prefix_len=prefix.shape[1],
+                    )))
+                return server.serve(drain_when_idle=True)
+
+            if plan is not None:
+                with inject.inject(plan):
+                    rc_b = phase_b()
+            else:
+                rc_b = phase_b()
+            wall = time.monotonic() - t0
+            # recovery tail (regime gone): keep ticking until the
+            # write-behind backlog drains and both breakers close
+            # (healthy pass: zero laps)
+            t1 = time.monotonic()
+            deadline = t1 + 60.0
+            while time.monotonic() < deadline and (
+                server._dirty_sessions
+                or any(b.state != "closed"
+                       for b in server._breakers.values())
+            ):
+                time.sleep(0.02)
+                server.serve(drain_when_idle=True)
+            recovery_s = time.monotonic() - t1
+            flat = server.metrics.counters_flat()
+            fd = server._statusz()["failure_domains"]
+            ok_tokens = sum(
+                p.result.new_tokens for p in pendings
+                if p.result is not None and p.result.status == "ok"
+            )
+            return {
+                "rc": [rc_a, rc_b],
+                "tokens_per_sec": round(ok_tokens / wall, 2),
+                "wall_s": round(wall, 3),
+                "completed": sum(
+                    1 for p in pendings if p.result is not None
+                ),
+                "failed": flat.get("failed", 0),
+                "shed": flat.get("shed", 0),
+                "prefix_hits": flat.get("prefix_hits", 0),
+                "prefix_misses": flat.get("prefix_misses", 0),
+                "recovery_s": round(recovery_s, 3),
+                "dirty_after_recovery": fd["dirty_backlog"],
+                "breaker_trips": {
+                    n: b["trips"] for n, b in fd["breakers"].items()
+                },
+                "health_final": server.health.state.value,
+                "_snapshot": server.metrics.snapshot(),
+            }
+        finally:
+            server.close()
+
+    out = {
+        "config": config, "n_sessions": n_sessions,
+        "n_prefix_arrivals": n_prefix, "prefix_len": prefix_len,
+        "suffix_len": suffix_len, "max_new_tokens": max_new,
+        "slots": slots, "chunk": chunk, "prefill_chunk": prefill_chunk,
+    }
+    roots = [tempfile.mkdtemp(prefix=f"orion-outage-bench-{tag}-")
+             for tag in ("warm", "base", "outage")]
+    try:
+        one_pass(roots[0], outage=False)  # untimed jit-warm lap
+        base = one_pass(roots[1], outage=False)
+        base.pop("_snapshot")
+        outage = one_pass(roots[2], outage=True)
+        snap = outage.pop("_snapshot")
+        out["baseline"] = base
+        out["outage"] = outage
+        out["outage_over_baseline_tokens_per_sec"] = round(
+            outage["tokens_per_sec"]
+            / max(base["tokens_per_sec"], 1e-9), 3
+        )
+        rows, ok = obs_slo.check_snapshot(
+            [obs_slo.Objective(name="error_rate", kind="error_rate",
+                               target=0.99),
+             obs_slo.Objective(name="availability", kind="availability",
+                               target=0.99)],
+            snap,
+        )
+        out["slo_check"] = "ok" if ok else "VIOLATED"
+        if not ok:
+            out["slo_check_rows"] = rows
+    finally:
+        for root in roots:
+            shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def bench_session_admission(model, params, chunk: int = 4,
                             history_new: int = 256, prompt_len: int = 8,
                             reps: int = 5) -> dict:
@@ -2383,6 +2573,13 @@ def main(argv=None) -> int:
                          "direct admission cost; updates the "
                          "'shared_prefix' row of BENCH_SERVE.json in "
                          "place (the full --serve run includes it too)")
+    ap.add_argument("--store-outage", action="store_true",
+                    help="serve the session+prefix arrival trace healthy "
+                         "and through a mid-trace full outage of both "
+                         "shared stores, score the degraded tokens/s and "
+                         "the zero-failed/zero-shed contract, and update "
+                         "the 'store_outage' row of BENCH_SERVE.json in "
+                         "place")
     ap.add_argument("--remat-sweep", action="store_true",
                     help="policy x skip operating-point sweep (VERDICT r4)")
     args = ap.parse_args(argv)
@@ -2504,6 +2701,20 @@ def main(argv=None) -> int:
             "warm_over_cold_tokens_per_sec":
                 res.get("warm_over_cold_tokens_per_sec"),
             "admit_cold_over_warm": res.get("admit_cold_over_warm"),
+            "slo_check": res.get("slo_check"),
+        }))
+        return 0
+
+    if args.store_outage:
+        res = bench_store_outage()
+        _update_bench_serve_row("store_outage", res)
+        print(json.dumps({
+            "metric": "serve_store_outage_tiny",
+            "outage_over_baseline_tokens_per_sec":
+                res["outage_over_baseline_tokens_per_sec"],
+            "failed": res["outage"]["failed"],
+            "shed": res["outage"]["shed"],
+            "recovery_s": res["outage"]["recovery_s"],
             "slo_check": res.get("slo_check"),
         }))
         return 0

@@ -22,6 +22,23 @@ its publishing rename). Append mode is exempt — an append-only log
 (metrics jsonl) is prefix-valid by construction, no rename can help it.
 Reads are exempt. Test code is exempt. Real exceptions use the standard
 ``# orion: noqa[non-atomic-persist]`` / baseline escape hatch.
+
+``raw-store-io`` — the shared-storage clients (``session_store.py``,
+                     ``prefix_store.py``) route every syscall through
+                     breaker-gated ``_io_*`` helpers: each helper checks
+                     ``CircuitBreaker.blocked()`` before touching the
+                     filesystem, so an open breaker means zero disk probes
+                     on the hot path (the whole point of the failure-domain
+                     design — a dead NFS mount must not stall chunk_ms).
+                     A direct ``open()`` / ``os.replace`` / ``os.listdir``
+                     call anywhere else in those modules bypasses the gate:
+                     it reintroduces a blocking syscall the outage regime
+                     can hang for seconds, invisible to the breaker's
+                     failure accounting. Heuristic (AST-only): flag those
+                     three calls in the two store modules unless the
+                     enclosing function is itself an ``_io_`` helper.
+                     Test code is exempt; real exceptions use
+                     ``# orion: noqa[raw-store-io]`` / the baseline.
 """
 
 from __future__ import annotations
@@ -99,4 +116,43 @@ class NonAtomicPersistRule:
             )
 
 
-RULES = [NonAtomicPersistRule()]
+_STORE_MODULES = ("session_store.py", "prefix_store.py")
+
+# The syscalls the stores actually issue on their hot paths. os.makedirs at
+# construction time is deliberately not listed: it runs once, before the
+# breaker exists, and failing there is a config error, not an outage.
+_RAW_STORE_CALLS = ("open", "os.replace", "os.listdir")
+
+
+class RawStoreIORule:
+    id = "raw-store-io"
+    title = "store syscall outside a breaker-gated _io_* helper"
+
+    _enclosing_function = NonAtomicPersistRule._enclosing_function
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.is_test:
+            return
+        if not ctx.path.endswith(_STORE_MODULES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name not in _RAW_STORE_CALLS:
+                continue
+            scope = self._enclosing_function(node)
+            if (scope is not None
+                    and scope.name.startswith("_io_")):
+                continue  # the sanctioned breaker-gated helper itself
+            yield Finding(
+                self.id, ctx.path, node.lineno,
+                f"{name}(...) hits the store filesystem without the "
+                "breaker gate: route it through an _io_* helper (which "
+                "checks CircuitBreaker.blocked() first) so an open "
+                "breaker means zero syscalls on the request path, or "
+                "suppress with # orion: noqa[raw-store-io]",
+            )
+
+
+RULES = [NonAtomicPersistRule(), RawStoreIORule()]

@@ -96,10 +96,22 @@ class Router:
                     return
             self.replicas.append(new)
 
-    def _candidates(self) -> List[Tuple]:
-        """Routable replicas, best-first: (health rank, inflight,
-        slo penalty, index). DRAINING/DEAD/dead-process replicas never
-        appear.
+    def _candidates(self, session_id: Optional[str] = None) -> List[Tuple]:
+        """Routable replicas, best-first: (affinity, health rank,
+        inflight, slo penalty, index). DRAINING/DEAD/dead-process
+        replicas never appear.
+
+        The AFFINITY term (ISSUE 17) engages only during a store
+        outage: a replica DEGRADED with reason ``store-outage:*`` that
+        holds ``session_id`` RESIDENT (its last status snapshot lists
+        the id) sorts before every other candidate, healthy ones
+        included — during the outage it is the only replica that can
+        serve the turn at all (everyone else needs the dead store for
+        the session load and sheds), and its write-behind copy is the
+        only up-to-date one. Outside an outage the term is 0 everywhere
+        and placement is pure load balancing as before. Store-outage
+        replicas WITHOUT the session stay deprioritized by the health
+        rank but remain routable (cold prefix misses still serve).
 
         The SLO penalty — ``(fast-burn firing?, windowed p99 ms)`` from
         each replica's last status snapshot — is the LATENCY-AWARE
@@ -124,11 +136,23 @@ class Router:
         for i, r in enumerate(replicas):
             if not r.routable:
                 continue
-            rank = _HEALTH_RANK.get(r.health_state())
+            state = r.health_state()
+            rank = _HEALTH_RANK.get(state)
             if rank is None:
                 continue
-            out.append((rank, r.inflight, r.slo_penalty(), i, r))
-        out.sort(key=lambda t: t[:4])
+            affinity = 0
+            if session_id is not None and state == "degraded":
+                status = getattr(r, "last_status", None) or {}
+                if str(status.get("reason") or "").startswith(
+                        "store-outage:"):
+                    resident = (
+                        (status.get("sessions") or {}).get("resident_ids")
+                        or ()
+                    )
+                    if session_id in resident:
+                        affinity = -1
+            out.append((affinity, rank, r.inflight, r.slo_penalty(), i, r))
+        out.sort(key=lambda t: t[:5])
         return out
 
     # -- dispatch -------------------------------------------------------------
@@ -155,7 +179,7 @@ class Router:
         # flag and the candidate scan reads replica-side health state —
         # neither belongs in the strict-scope bookkeeping section
         turn_done = threading.Event() if sid is not None else None
-        candidates = self._candidates()
+        candidates = self._candidates(sid)
         with self._lock:
             if self._dispatches % 256 == 0:
                 # amortized sweep: a conversation that never returns

@@ -92,6 +92,7 @@ class Supervisor:
         self._spawn_count = 0  # fleet.replica_spawn's step address
         self._misses: dict = {}
         self._burns: dict = {}  # consecutive fast-burn heartbeats
+        self._suppressed: set = set()  # store-outage respawns suppressed
         self.replicas: List[ReplicaHandle] = []
         self.router: Optional[Router] = None
         self.events: List[tuple] = []  # (t, replica name, what) audit log
@@ -173,7 +174,28 @@ class Supervisor:
                 continue
             self._misses[replica.name] = 0
             state = status.get("state")
-            if state == "degraded":
+            reason = str(status.get("reason") or "")
+            if not (state == "degraded"
+                    and reason.startswith("store-outage:")):
+                self._suppressed.discard(replica.name)  # episode over
+            if state == "degraded" and reason.startswith("store-outage:"):
+                # a replica DEGRADED because a SHARED store's breaker is
+                # open must NOT be drained-and-respawned: a fresh
+                # process meets the same dead store, minus this one's
+                # resident sessions — the dirty write-behind copies that
+                # are the ONLY up-to-date turns during the outage. A
+                # drain here is how "store blip" becomes "lost turns".
+                # Leave it serving (prefix = cold prefill, sessions =
+                # write-behind); the router already deprioritizes it.
+                if replica.name not in self._suppressed:
+                    # once per outage episode, not per heartbeat — the
+                    # audit log names the decision, the breaker's own
+                    # transitions carry the play-by-play
+                    self._suppressed.add(replica.name)
+                    self._event(
+                        replica.name, f"respawn_suppressed ({reason})"
+                    )
+            elif state == "degraded":
                 self._drain_respawn(idx, replica, "degraded")
             elif state == "dead":
                 self._event(replica.name, "reports dead; respawning")
@@ -240,6 +262,7 @@ class Supervisor:
     def _replace(self, idx: int, old: ReplicaHandle) -> None:
         self._misses.pop(old.name, None)
         self._burns.pop(old.name, None)
+        self._suppressed.discard(old.name)
         new = self._spawn(idx)
         # only reachable via tick()/_drain_respawn(), i.e. after start()
         # built the router (the replicas list IS the router's list)

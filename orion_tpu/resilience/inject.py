@@ -81,11 +81,12 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import errno
 import os
 import signal
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 _NAN_SITE = "train.nan"
 _DECODE_NAN_SITE = "decode.state_nan"
@@ -111,6 +112,12 @@ SITES = {
     "decode.state_nan": "DecodeSession decode-state poisoning marker",
     "serve.session_save": "serving/session_store.py save, inside retry",
     "serve.session_load": "serving/session_store.py load, inside retry",
+    "serve.session_scan": "serving/session_store.py generations(), before "
+                          "the directory listing — the staleness probe a "
+                          "shared-store replica pays per lookup",
+    "serve.prefix_scan": "serving/prefix_store.py generations(), before "
+                         "the directory listing — the per-candidate "
+                         "existence probe of a prefix lookup",
     "serve.prefix_save": "serving/prefix_store.py publish, inside the "
                          "retried write of one prefix generation",
     "serve.prefix_load": "serving/prefix_store.py lookup, inside the "
@@ -125,9 +132,34 @@ SITES = {
 # dynamically-addressed site families (matched by prefix)
 SITE_PREFIXES = ("decode.slot_nan.",)
 
+# Sustained-regime fault kinds (FaultPlan.degrade_site): how a degraded
+# site fails for the whole regime window, not just one occurrence.
+# ``eio``/``enospc`` raise the matching OSError (media failure / full
+# disk), ``partition`` raises ETIMEDOUT (the store is network-attached
+# and the network is gone), ``latency`` adds host delay but succeeds —
+# the regime a breaker must catch WITHOUT an error ever surfacing.
+REGIME_KINDS = ("eio", "enospc", "latency", "partition")
+
+_REGIME_ERRNO = {
+    "eio": errno.EIO,
+    "enospc": errno.ENOSPC,
+    "partition": errno.ETIMEDOUT,
+}
+
 
 def known_site(site: str) -> bool:
     return site in SITES or site.startswith(SITE_PREFIXES)
+
+
+def known_regime_prefix(prefix: str) -> bool:
+    """A regime prefix must cover at least one registered site (or site
+    family) — a regime that can never fire is a typo, same contract as
+    :meth:`FaultPlan.add`."""
+    return (
+        any(s == prefix or s.startswith(prefix) for s in SITES)
+        or any(p == prefix or p.startswith(prefix) for p in SITE_PREFIXES)
+        or prefix.startswith(SITE_PREFIXES)
+    )
 
 
 def _decode_slot_site(slot: int) -> str:
@@ -142,6 +174,24 @@ class _Fault:
     step: Optional[int]  # None = any step
     times: int  # remaining deliveries; <0 = unlimited
     action: Optional[Callable[[], None]]  # None = marker (consumed via query)
+
+
+@dataclasses.dataclass
+class _Regime:
+    """A sustained outage: every fire() on a site matching ``prefix``
+    fails (or stalls) while the regime clock is inside
+    ``[from_step, until_step)``. The clock is the last step observed at
+    ``clock_site`` — by default ``serve.chunk_delay``, the server's
+    lifetime chunk ordinal, so "the store is down for chunks 10..30" is
+    one deterministic sentence regardless of how each store site numbers
+    its own steps (generation numbers, spawn ordinals, ...)."""
+
+    prefix: str
+    kind: str  # one of REGIME_KINDS
+    from_step: int
+    until_step: Optional[int]  # exclusive; None = never ends
+    latency: float
+    clock_site: str
 
 
 # delivery observers (the telemetry spine's black box): every DELIVERED
@@ -180,8 +230,11 @@ class FaultPlan:
 
     def __init__(self):
         self._faults: List[_Fault] = []
+        self._regimes: List[_Regime] = []
+        self._regime_clock: Dict[str, int] = {}  # clock_site -> last step
         self._lock = threading.Lock()
         self.delivered: List[str] = []  # "(site, step)" log for assertions
+        self.sleep: Callable[[float], None] = time.sleep  # latency regimes
 
     # -- authoring -----------------------------------------------------------
 
@@ -216,6 +269,51 @@ class FaultPlan:
             raise exc(f"{msg} [site={site}]")
 
         return self.add(site, step, times, raise_)
+
+    def degrade_site(
+        self,
+        prefix: str,
+        kind: str = "eio",
+        from_step: int = 0,
+        until_step: Optional[int] = None,
+        latency: float = 0.05,
+        clock_site: str = "serve.chunk_delay",
+    ) -> "FaultPlan":
+        """Arm a SUSTAINED fault regime: every hook whose site starts
+        with ``prefix`` fails (``kind`` in :data:`REGIME_KINDS`) for as
+        long as the regime clock sits in ``[from_step, until_step)`` —
+        the clock being the last step fired at ``clock_site`` (default
+        ``serve.chunk_delay``, the server-lifetime chunk ordinal), so an
+        outage window is phrased in one fleet-visible unit instead of
+        each site's private step numbering. ``until_step=None`` never
+        recovers (the SIGTERM-mid-outage drill). ``latency`` is the added
+        host delay per operation for ``kind="latency"`` (the operation
+        then SUCCEEDS — the brownout a breaker must catch without any
+        error surfacing). Regimes layer UNDER one-shot faults: an armed
+        one-shot at the same (site, step) takes precedence."""
+        if kind not in REGIME_KINDS:
+            raise ValueError(
+                f"unknown regime kind {kind!r}; expected one of "
+                f"{REGIME_KINDS}"
+            )
+        if not known_regime_prefix(prefix):
+            raise ValueError(
+                f"regime prefix {prefix!r} covers no registered "
+                "fault-injection site: a regime no hook can enter never "
+                "delivers — register the site(s) in inject.SITES first"
+            )
+        if not known_site(clock_site):
+            raise ValueError(f"unknown regime clock site {clock_site!r}")
+        if until_step is not None and until_step <= from_step:
+            raise ValueError(
+                f"empty regime window [{from_step}, {until_step})"
+            )
+        self._regimes.append(_Regime(
+            prefix, kind, int(from_step),
+            None if until_step is None else int(until_step),
+            float(latency), clock_site,
+        ))
+        return self
 
     def preempt_at(self, step: int, sig: int = signal.SIGTERM) -> "FaultPlan":
         """Deliver a real OS signal at the given step's boundary. With a
@@ -298,9 +396,58 @@ class FaultPlan:
         return taken
 
     def fire(self, site: str, step: Optional[int] = None) -> None:
+        if self._regimes:
+            self._advance_regime_clock(site, step)
         f = self._take(site, step)
-        if f is not None and f.action is not None:
-            f.action()
+        if f is not None:
+            if f.action is not None:
+                f.action()
+            return
+        if self._regimes:
+            self._fire_regime(site, step)
+
+    def _advance_regime_clock(self, site: str, step: Optional[int]) -> None:
+        if step is None:
+            return
+        with self._lock:
+            for r in self._regimes:
+                if r.clock_site == site:
+                    prev = self._regime_clock.get(site, -1)
+                    self._regime_clock[site] = max(prev, int(step))
+
+    def _fire_regime(self, site: str, step: Optional[int]) -> None:
+        """Deliver the first matching active regime (recorded in
+        ``delivered`` and reported to observers exactly like a one-shot
+        fault — the flight-recorder parity meta-test covers regimes for
+        free). ``eio``/``enospc``/``partition`` raise; ``latency`` sleeps
+        outside the lock, then succeeds."""
+        match = None
+        with self._lock:
+            for r in self._regimes:
+                if not site.startswith(r.prefix):
+                    continue
+                # before the clock site ever fires, the regime clock
+                # reads 0: a from_step=0 regime is live from process
+                # start (the store can be down before the first chunk)
+                now = self._regime_clock.get(r.clock_site, 0)
+                if now < r.from_step:
+                    continue
+                if r.until_step is not None and now >= r.until_step:
+                    continue
+                self.delivered.append(f"{site}@{step}")
+                match = r
+                break
+        if match is None:
+            return
+        _notify_delivery(site, step)
+        if match.kind == "latency":
+            self.sleep(match.latency)
+            return
+        raise OSError(
+            _REGIME_ERRNO[match.kind],
+            f"injected sustained {match.kind} regime "
+            f"[site={site} prefix={match.prefix}]",
+        )
 
     def consume_marker(self, site: str, step: Optional[int] = None) -> bool:
         return self._take(site, step) is not None
@@ -455,5 +602,6 @@ __all__ = [
     "decode_nan_armed", "decode_slot_nan_armed", "corrupt_step",
     "truncate_step", "corrupt_session", "truncate_session",
     "SITES", "SITE_PREFIXES", "known_site",
+    "REGIME_KINDS", "known_regime_prefix",
     "add_observer", "remove_observer",
 ]

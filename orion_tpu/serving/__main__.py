@@ -135,6 +135,28 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--session-idle-s", type=float, default=300.0,
                    help="resident session-cache idle eviction at chunk "
                         "boundaries (state stays on disk; 0 = off)")
+    p.add_argument("--max-dirty-sessions", type=int, default=32,
+                   help="write-behind bound during a session-store "
+                        "outage: beyond this many DIRTY resident "
+                        "sessions (save failed; host copy is the only "
+                        "up-to-date one) NEW session admissions shed "
+                        "with a retriable overload error while dirty "
+                        "sessions keep serving (0 = unbounded)")
+    p.add_argument("--breaker-failures", type=int, default=3,
+                   help="consecutive failed store operations that OPEN "
+                        "a store's circuit breaker: every touch then "
+                        "fails in O(1) host work (no syscalls against "
+                        "dead storage), health reports DEGRADED "
+                        "'store-outage:<store>', and requests keep "
+                        "serving (prefix = cold prefill, sessions = "
+                        "write-behind)")
+    p.add_argument("--breaker-backoff", type=float, default=0.5,
+                   help="open-breaker dwell (seconds) before the first "
+                        "half-open probe; doubles per re-trip up to "
+                        "--breaker-max-backoff, jittered so a fleet's "
+                        "probes don't synchronize")
+    p.add_argument("--breaker-max-backoff", type=float, default=30.0,
+                   help="probe backoff ceiling (seconds)")
     p.add_argument("--grace", type=float, default=30.0,
                    help="SIGTERM drain budget (seconds)")
     p.add_argument("--metrics-path", default=None,
@@ -319,6 +341,10 @@ def _run(args, guard) -> int:
             prefill_chunk=args.prefill_chunk,
             prompt_overflow=args.prompt_overflow,
             session_dir=args.session_dir, session_idle_s=args.session_idle_s,
+            max_dirty_sessions=args.max_dirty_sessions,
+            breaker_failures=args.breaker_failures,
+            breaker_backoff=args.breaker_backoff,
+            breaker_max_backoff=args.breaker_max_backoff,
             spec_depth=args.spec_depth,
             spec_min_accept=args.spec_min_accept,
             qmode=args.qmode, prefix_dir=args.prefix_dir,

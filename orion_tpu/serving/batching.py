@@ -93,6 +93,7 @@ from orion_tpu.models.transformer import (
     snapshot_decode_state,
 )
 from orion_tpu.resilience import inject
+from orion_tpu.resilience.breaker import StoreUnavailableError
 from orion_tpu.serving.session import DecodeRequest, DecodeResult
 from orion_tpu.serving.session_store import SessionState
 
@@ -473,6 +474,15 @@ class SlotEngine:
         if prefix_store is not None:
             self.attach_prefix_store(prefix_store)
         self._pending_prefix: List[Tuple[str, Any]] = []  # (key, tokens)
+        # the publish queue is BOUNDED: during a store outage novel
+        # prefixes keep arriving but nothing drains, and an unbounded
+        # queue would hold every queued prompt's token rows in host
+        # memory for the whole outage. Beyond the cap the prefix is
+        # dropped (a counted drop, surfaced via the prefix_drop event
+        # and /statusz) — dropping a CACHE entry costs a later cold
+        # prefill, never correctness.
+        self.max_pending_prefixes = 32
+        self.dropped_prefixes = 0  # lifetime counted drops
         self._sample: Optional[SampleConfig] = None  # set by first admit
         self._slots: List[Optional[_Slot]] = [None] * self.slots
         self._chunk_counter = 0  # global boundary index (serve.chunk hook)
@@ -835,8 +845,24 @@ class SlotEngine:
         key = self.prefix_store.key_for(row)
         if any(k == key for k, _ in self._pending_prefix):
             return
-        if self.prefix_store.generations(key):
-            return  # already committed (here or on another replica)
+        br = self.prefix_store.breaker
+        if br is not None and br.is_open:
+            # store outage: NO per-request disk probe (the dedup scan
+            # below would block on dead storage on the admission path).
+            # Queue blind — the publish pass re-checks existence after
+            # recovery, and the bounded queue caps what we hold.
+            pass
+        else:
+            try:
+                if self.prefix_store.generations(key):
+                    return  # already committed (here or another replica)
+            except StoreUnavailableError:
+                pass  # breaker tripped mid-check: queue blind, as above
+        if len(self._pending_prefix) >= self.max_pending_prefixes:
+            self.dropped_prefixes += 1
+            self._emit("prefix_drop", key=key,
+                       dropped_total=self.dropped_prefixes)
+            return
         self._pending_prefix.append((key, row))
 
     @property
@@ -846,6 +872,12 @@ class SlotEngine:
         a solo prefill + possibly a fresh bucket compile, the same cost
         class admission beats for)."""
         return bool(self._pending_prefix)
+
+    @property
+    def pending_prefix_count(self) -> int:
+        """Depth of the bounded publish queue (the /statusz failure-
+        domain section reads it next to ``dropped_prefixes``)."""
+        return len(self._pending_prefix)
 
     @_serialized
     def publish_pending_prefixes(self) -> int:
@@ -865,6 +897,12 @@ class SlotEngine:
         to be extracted for free (and the publish must not change the
         piece schedule, which is part of the bitwise contract)."""
         done = 0
+        br = self.prefix_store.breaker
+        if br is not None and br.blocked():
+            # outage, probe not yet due: O(1) host check and out — the
+            # queued entries wait (bounded) for the half-open probe;
+            # calling further down would just burn a warning per boundary
+            return 0
         while self._pending_prefix:
             key, row = self._pending_prefix.pop(0)
             try:
@@ -883,9 +921,27 @@ class SlotEngine:
                 done += 1
                 self._emit("prefix_publish", key=key,
                            length=int(row.shape[1]), generation=gen)
+            except StoreUnavailableError:
+                # breaker open (or the probe this pass rode just
+                # failed): requeue and stop — no warning spam, the
+                # entry publishes after recovery
+                self._pending_prefix.insert(0, (key, row))
+                break
             except Exception as e:
                 import warnings
 
+                if br is not None and br.is_open:
+                    # this failure is the one that TRIPPED the breaker
+                    # (or rode a failed probe): keep the entry — it
+                    # publishes after recovery, and retrying it is the
+                    # natural half-open probe that closes the breaker
+                    self._pending_prefix.insert(0, (key, row))
+                    warnings.warn(
+                        f"prefix publish failed ({type(e).__name__}); "
+                        "store breaker open — entry queued for recovery",
+                        stacklevel=2,
+                    )
+                    break
                 warnings.warn(
                     f"prefix publish failed ({type(e).__name__}: {e}); "
                     "serving continues uncached",

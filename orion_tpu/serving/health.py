@@ -106,6 +106,7 @@ class HealthMachine:
         # reentrant when shared (the snapshot caller holds it already).
         self._lock = lock if lock is not None else threading.Lock()
         self._state = Health.STARTING
+        self._reason = "init"
         self._since = clock()
         self._history_limit = int(history_limit)
         self.dropped = 0  # transitions aged out of the bounded history
@@ -116,6 +117,15 @@ class HealthMachine:
     @property
     def state(self) -> Health:
         return self._state
+
+    @property
+    def reason(self) -> str:
+        """Why we entered the CURRENT state (the reason of the last
+        transition). Balancers and the fleet supervisor need the why,
+        not just the word: a replica DEGRADED for ``store-outage:*``
+        must not be respawned (a new process meets the same dead store),
+        while one degraded for a wedged engine must."""
+        return self._reason
 
     @property
     def accepting(self) -> bool:
@@ -138,6 +148,7 @@ class HealthMachine:
                     f" ({reason or 'no reason given'})"
                 )
             self._state = new
+            self._reason = reason
             self._since = self._clock()
             self.history.append((old, new, reason, self._since))
             if len(self.history) > self._history_limit:
@@ -148,6 +159,31 @@ class HealthMachine:
             self._on_transition(old, new, reason)
         return True
 
+    def restate(self, reason: str) -> bool:
+        """Re-reason the CURRENT state without a transition. The cause of
+        a sticky state can sharpen after entry — a save failure degrades
+        with a generic reason, then the circuit breaker trips and the
+        same episode is recognized as a store outage — and the consumers
+        of ``reason`` (the supervisor's respawn suppression, /healthz's
+        status line) act on the sharper why. Recorded in the bounded
+        history as an ``old == new`` edge and reported to
+        ``on_transition`` like any transition; ``_since`` is untouched
+        (the state itself did not change). No-op if the reason already
+        matches."""
+        with self._lock:
+            if reason == self._reason:
+                return False
+            state = self._state
+            self._reason = reason
+            self.history.append((state, state, reason, self._clock()))
+            if len(self.history) > self._history_limit:
+                drop = len(self.history) - self._history_limit
+                del self.history[:drop]
+                self.dropped += drop
+        if self._on_transition is not None:
+            self._on_transition(state, state, reason)
+        return True
+
     def snapshot(self) -> dict:
         """The /healthz payload: current state, how long we've been in
         it, and the last ``history_limit`` transitions (``dropped``
@@ -156,6 +192,7 @@ class HealthMachine:
         with self._lock:
             return {
                 "state": self._state.value,
+                "reason": self._reason,
                 "accepting": self.accepting,
                 "in_state_secs": self._clock() - self._since,
                 "dropped": self.dropped,

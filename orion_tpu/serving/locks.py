@@ -207,6 +207,7 @@ _COST = "orion_tpu/obs/cost.py"
 _FLIGHT = "orion_tpu/obs/flight.py"
 _WATCHDOG = "orion_tpu/resilience/watchdog.py"
 _INJECT = "orion_tpu/resilience/inject.py"
+_BREAKER = "orion_tpu/resilience/breaker.py"
 
 LOCKS: Dict[str, LockDecl] = {
     decl.name: decl
@@ -479,6 +480,37 @@ LOCKS: Dict[str, LockDecl] = {
             strict_scope=True,
         ),
         LockDecl(
+            name="breaker.lock",
+            site=LockSite(_BREAKER, "CircuitBreaker", "_lock"),
+            kind="Lock",
+            note="the circuit breaker's state machine (ISSUE 17): "
+            "state/window/probe bookkeeping only. This lock sits on "
+            "EVERY store syscall's fast path (blocked() per _io_* "
+            "helper) and on the scheduler's per-boundary outage check, "
+            "so its held scope is one branch and a clock read — "
+            "transition observers (flight ring, metrics, the health "
+            "latch) run AFTER release via _notify, and store I/O "
+            "obviously never runs under the gate that exists to avoid "
+            "it. Strict scope enforces all of that.",
+            guards=(
+                GuardedField(
+                    _BREAKER, "CircuitBreaker",
+                    ("_state", "_consec", "_trips", "_probe_at",
+                     "_opened_at", "_open_count", "_last_reason"),
+                    note="the scheduler thread, submit threads (prefix "
+                    "lookups), and scrape threads (snapshot) all read/"
+                    "write breaker state",
+                ),
+            ),
+            bans=("wire", "sleep", "disk-io", "subprocess", "device-sync"),
+            strict_scope=True,
+            # the jittered dwell draws from the breaker's own seeded rng
+            # inside _open_locked: O(1) host arithmetic, and drawing
+            # under the lock keeps the deterministic jitter sequence
+            # well-defined when concurrent operations race to trip
+            allow_calls=("random",),
+        ),
+        LockDecl(
             name="inject.plan",
             site=LockSite(_INJECT, "FaultPlan", "_lock"),
             kind="Lock",
@@ -518,6 +550,11 @@ ORDER: Tuple[Tuple[str, str], ...] = (
     # (inflight gauges) may be read below it, never above it
     ("router.lock", "replica.state"),
     ("router.lock", "replica.local"),
+    # a metrics scrape evaluates the breaker_state gauge_fn (which takes
+    # the breaker lock to read .state) while holding the registry lock;
+    # the reverse never happens — breaker observers run after release
+    # and the strict scope bans foreign calls under the breaker lock
+    ("server.stats", "breaker.lock"),
 )
 
 

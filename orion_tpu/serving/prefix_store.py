@@ -68,6 +68,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
+from orion_tpu.resilience.breaker import CircuitBreaker, StoreUnavailableError
 from orion_tpu.resilience.inject import fire
 from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
 from orion_tpu.serving.session_store import (
@@ -140,6 +141,7 @@ class PrefixStore:
         observer: Optional[Callable[[str, float, int], None]] = None,
         clock: Callable[[], float] = time.monotonic,
         max_probes: int = 64,
+        breaker: Optional[CircuitBreaker] = None,
     ):
         if align < 1:
             raise ValueError(f"align must be >= 1, got {align}")
@@ -155,6 +157,7 @@ class PrefixStore:
         self._should_abort = should_abort
         self._observer = observer
         self._clock = clock
+        self.breaker = breaker
         os.makedirs(self.directory, exist_ok=True)
 
     def _observe(self, op: str, t0: float, nbytes: int) -> None:
@@ -163,6 +166,55 @@ class PrefixStore:
                 self._observer(op, (self._clock() - t0) * 1e3, nbytes)
             except Exception:
                 pass  # telemetry must never fail the I/O it measures
+
+    # -- breaker gate and raw I/O ---------------------------------------------
+    # Same discipline as the session store (lint rule ``raw-store-io``):
+    # the ``_io_*`` helpers are the module's only direct filesystem touch
+    # points and fail fast while the breaker is open, so an open breaker
+    # turns every lookup into an O(1)-host-work MISS (cold prefill) with
+    # zero per-request disk probes.
+
+    def _exit(self, ok: bool, reason: str = "") -> None:
+        if self.breaker is None:
+            return
+        if ok:
+            self.breaker.record_success()
+        else:
+            self.breaker.record_failure(reason)
+
+    def _blocked_check(self) -> None:
+        if self.breaker is not None and self.breaker.blocked():
+            raise StoreUnavailableError("prefix")
+
+    def _io_open(self, path: str, mode: str = "r", **kw):
+        self._blocked_check()
+        return open(path, mode, **kw)
+
+    def _io_listdir(self, path: str) -> List[str]:
+        """Directory scan, or [] when the entry doesn't exist — an
+        unpublished prefix is a normal miss, not a store fault."""
+        self._blocked_check()
+        fire("serve.prefix_scan")
+        try:
+            return os.listdir(path)
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+
+    def _io_replace(self, src: str, dst: str) -> None:
+        self._blocked_check()
+        os.replace(src, dst)
+
+    def _io_makedirs(self, path: str) -> None:
+        self._blocked_check()
+        os.makedirs(path, exist_ok=True)
+
+    def _io_remove(self, path: str) -> None:
+        self._blocked_check()
+        os.remove(path)
+
+    def _io_rmdir(self, path: str) -> None:
+        self._blocked_check()
+        os.rmdir(path)
 
     # -- keys and paths -------------------------------------------------------
 
@@ -194,12 +246,11 @@ class PrefixStore:
     def generations(self, key: str) -> List[int]:
         """COMMITTED generations of one entry (manifest present), oldest
         first — a ``.bin`` without its ``.json`` is a torn publish and is
-        invisible (the session store's commit-point rule)."""
-        d = self._dir(key)
-        if not os.path.isdir(d):
-            return []
+        invisible (the session store's commit-point rule). Raises
+        StoreUnavailableError without touching disk while the breaker is
+        open (callers degrade to a miss / a counted publish drop)."""
         out = []
-        for name in os.listdir(d):
+        for name in self._io_listdir(self._dir(key)):
             if name.startswith("gen-") and name.endswith(".json"):
                 try:
                     out.append(int(name[len("gen-"):-len(".json")]))
@@ -209,9 +260,8 @@ class PrefixStore:
 
     def list_keys(self) -> List[str]:
         return sorted(
-            n for n in os.listdir(self.directory)
-            if os.path.isdir(os.path.join(self.directory, n))
-            and self.generations(n)
+            n for n in self._io_listdir(self.directory)
+            if self.generations(n)
         )
 
     # -- candidates -----------------------------------------------------------
@@ -265,18 +315,68 @@ class PrefixStore:
         mismatch — degrades to trying the next generation, then the next
         (shorter) candidate, then a miss: a prefix can always be
         recomputed from the prompt, so the cold path is the fallback and
-        the request NEVER fails here."""
+        the request NEVER fails here.
+
+        Breaker policy: an OPEN breaker is an INSTANT miss — one
+        ``allow()`` host check, zero disk probes (no sha256-then-listdir
+        walk against dead storage on the admission path). One completed
+        walk is one breaker sample: any OSError seen is a failure,
+        a clean hit or clean miss a success."""
         toks = np.asarray(prompt, np.int32).reshape(1, -1)
-        for length in self.candidate_lengths(toks.shape[1], declared):
+        lengths = self.candidate_lengths(toks.shape[1], declared)
+        if not lengths:
+            return None
+        if self.breaker is not None and not self.breaker.allow():
+            return None  # open: cold prefill, fail-fast
+        try:
+            entry, os_fail, aborted = self._lookup_walk(toks, lengths)
+        except BaseException:
+            self._exit(False, "lookup: aborted")
+            raise
+        if aborted:
+            # the breaker tripped under us mid-walk (a concurrent
+            # operation reported first): miss, no sample of our own
+            return None
+        if os_fail is not None:
+            self._exit(False, f"lookup: {type(os_fail).__name__}")
+        else:
+            self._exit(True)
+        return entry
+
+    def _lookup_walk(
+        self, toks: np.ndarray, lengths: List[int]
+    ) -> Tuple[Optional[PrefixEntry], Optional[OSError], bool]:
+        """The candidate walk of :meth:`lookup`; returns
+        ``(entry, first OSError seen, aborted-by-open-breaker)`` and
+        never lets a store error escape."""
+        os_fail: Optional[OSError] = None
+        for length in lengths:
             prefix = toks[:, :length]
             key = self.key_for(prefix)
-            gens = self.generations(key)
+            try:
+                gens = self.generations(key)
+            except StoreUnavailableError:
+                return None, None, True
+            except OSError as e:
+                os_fail = e
+                continue
             if not gens:
                 continue
             t0 = self._clock()
             for gen in reversed(gens):
                 try:
                     entry, nbytes = self._load_gen(key, gen)
+                except StoreUnavailableError:
+                    return None, None, True
+                except OSError as e:  # store-shaped: counts as evidence
+                    os_fail = e
+                    warnings.warn(
+                        f"prefix {key} generation {gen} is unreadable "
+                        f"({type(e).__name__}: {str(e)[:200]}); trying "
+                        "the previous generation",
+                        stacklevel=2,
+                    )
+                    continue
                 except Exception as e:  # damaged payloads: many types
                     warnings.warn(
                         f"prefix {key} generation {gen} is corrupt or "
@@ -297,17 +397,17 @@ class PrefixStore:
                     )
                     continue
                 self._observe("load", t0, nbytes)
-                return entry
-        return None
+                return entry, os_fail, False
+        return None, os_fail, False
 
     def _load_gen(self, key: str, gen: int) -> Tuple[PrefixEntry, int]:
         d = self._dir(key)
 
         def _read():
             fire("serve.prefix_load", step=gen)
-            with open(self._json(d, gen)) as f:
+            with self._io_open(self._json(d, gen)) as f:
                 doc = json.load(f)
-            with open(self._bin(d, gen), "rb") as f:
+            with self._io_open(self._bin(d, gen), "rb") as f:
                 blob = f.read()
             return doc, blob
 
@@ -361,7 +461,12 @@ class PrefixStore:
         steady state cheap: an already-committed entry is not rewritten
         (re-publishing the same content is legal and converges — the
         fault-model tests force it with ``skip_if_present=False``).
-        Returns the generation number, or None when skipped."""
+        Returns the generation number, or None when skipped.
+
+        Raises StoreUnavailableError (no disk syscalls) while the
+        breaker is open — the publish queue in serving/batching.py maps
+        that to a counted drop. One completed publish is one breaker
+        sample."""
         toks = np.asarray(tokens, np.int32).reshape(1, -1)
         if toks.shape[1] % self.align != 0 or toks.shape[1] == 0:
             raise ValueError(
@@ -369,10 +474,23 @@ class PrefixStore:
                 f"of the alignment {self.align}: the in-scan bitwise "
                 "contract needs piece boundaries on chunk boundaries"
             )
+        if self.breaker is not None and not self.breaker.allow():
+            raise StoreUnavailableError("prefix")
+        try:
+            return self._publish_op(toks, state, skip_if_present)
+        except StoreUnavailableError:
+            raise
+        except OSError as e:
+            self._exit(False, f"publish: {type(e).__name__}")
+            raise
+
+    def _publish_op(self, toks: np.ndarray, state: Any,
+                    skip_if_present: bool) -> Optional[int]:
         key = self.key_for(toks)
         d = self._dir(key)
         gens = self.generations(key)
         if gens and skip_if_present:
+            self._exit(True)  # the existence scan answered: store is up
             return None
         gen = (gens[-1] if gens else 0) + 1
         host_state = _host_tree(state)
@@ -410,15 +528,15 @@ class PrefixStore:
 
         def _write():
             fire("serve.prefix_save", step=gen)
-            os.makedirs(d, exist_ok=True)
+            self._io_makedirs(d)
             tmp_bin = self._bin(d, gen) + f".tmp-{nonce}"
-            with open(tmp_bin, "wb") as f:
+            with self._io_open(tmp_bin, "wb") as f:
                 f.write(blob)
-            os.replace(tmp_bin, self._bin(d, gen))
+            self._io_replace(tmp_bin, self._bin(d, gen))
             tmp_json = self._json(d, gen) + f".tmp-{nonce}"
-            with open(tmp_json, "w", encoding="utf-8") as f:
+            with self._io_open(tmp_json, "w", encoding="utf-8") as f:
                 json.dump(doc, f)
-            os.replace(tmp_json, self._json(d, gen))  # commit point
+            self._io_replace(tmp_json, self._json(d, gen))  # commit point
 
         t0 = self._clock()
         call_with_retries(
@@ -426,6 +544,7 @@ class PrefixStore:
             describe=f"prefix publish ({key} gen {gen})",
             should_abort=self._should_abort,
         )
+        self._exit(True)
         self._observe("save", t0, len(blob))
         self._gc(d, keep_from=gen)
         return gen
@@ -440,33 +559,39 @@ class PrefixStore:
         says racers complete independently)."""
         floor = keep_from - self.keep + 1
         now = time.time()
-        for name in os.listdir(d):
+        try:
+            names = self._io_listdir(d)
+        except (OSError, StoreUnavailableError):
+            return  # advisory: the next publish after recovery re-runs it
+        for name in names:
             path = os.path.join(d, name)
             try:
                 if ".tmp-" in name:
                     if now - os.path.getmtime(path) > 60.0:
-                        os.remove(path)
+                        self._io_remove(path)
                     continue
                 if not name.startswith("gen-"):
                     continue
                 gen = int(name.split(".", 1)[0][len("gen-"):])
                 if gen < floor:
-                    os.remove(path)
-            except (OSError, ValueError):
+                    self._io_remove(path)
+            except (OSError, ValueError, StoreUnavailableError):
                 continue
 
     def delete(self, key: str) -> None:
         d = self._dir(key)
-        if not os.path.isdir(d):
-            return
-        for name in os.listdir(d):
+        try:
+            names = self._io_listdir(d)
+        except (OSError, StoreUnavailableError):
+            return  # best-effort, like _gc
+        for name in names:
             try:
-                os.remove(os.path.join(d, name))
-            except OSError:
+                self._io_remove(os.path.join(d, name))
+            except (OSError, StoreUnavailableError):
                 pass
         try:
-            os.rmdir(d)
-        except OSError:
+            self._io_rmdir(d)
+        except (OSError, StoreUnavailableError):
             pass
 
 

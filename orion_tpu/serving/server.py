@@ -66,6 +66,7 @@ from orion_tpu.obs.flight import FlightRecorder
 from orion_tpu.obs.http import ObsHTTPServer
 from orion_tpu.obs.metrics import MetricsRegistry
 from orion_tpu.obs.trace import Tracer
+from orion_tpu.resilience.breaker import CircuitBreaker, StoreUnavailableError
 from orion_tpu.resilience.inject import fire
 from orion_tpu.resilience.preempt import PreemptionGuard
 from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
@@ -140,6 +141,24 @@ class ServeConfig:
     session_idle_s: float = 300.0  # resident-cache idle eviction (0 = off)
     max_resident_sessions: int = 64  # LRU cap on the host-resident cache
     session_keep: int = 2  # retained generations per session on disk
+    # -- storage failure domains (ISSUE 17; resilience/breaker.py) --
+    # Each shared store (session, prefix) gets its own circuit breaker:
+    # after breaker_failures consecutive failed operations the breaker
+    # OPENS and every store touch fails in O(1) host work (no syscalls
+    # against dead storage) until a jittered backoff expires and one
+    # half-open probe operation tests recovery. An open breaker reports
+    # health DEGRADED with reason "store-outage:<store>"; requests keep
+    # serving (prefix = cold prefill, sessions = write-behind).
+    breaker_failures: int = 3
+    breaker_backoff: float = 0.5  # open dwell before the first probe
+    breaker_max_backoff: float = 30.0  # probe backoff ceiling
+    # Write-behind bound during a session-store outage: DIRTY sessions
+    # (their save failed; the resident copy is the only up-to-date one)
+    # pin themselves in host memory until a save lands. Beyond this many
+    # dirty pins, NEW session-carrying admissions shed with a retriable
+    # OverloadError citing the store — bounding the turns this process
+    # can lose on a crash mid-outage. 0 = unbounded (trust the host).
+    max_dirty_sessions: int = 32
     # -- telemetry (orion_tpu/obs/): all host-side, zero device syncs --
     # Prometheus text dumped here (+ .json sibling) every
     # metrics_interval_s at chunk boundaries and always on drain/exit;
@@ -439,6 +458,13 @@ class Server:
         self._c_prefix_bytes = self.metrics.counter("prefix_bytes")
         self._h_prefix_load_ms = self.metrics.histogram("prefix_load_ms")
         self._h_prefix_save_ms = self.metrics.histogram("prefix_save_ms")
+        # -- storage failure domains (ISSUE 17): one breaker per shared
+        # store, constructed on the server's clock with an observer that
+        # black-boxes every transition; the health latch (_tick_store_
+        # health) and the status op read them from this registry
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._c_store_errors = self.metrics.counter("store_errors")
+        self._c_prefix_drops = self.metrics.counter("prefix_publish_drops")
         if cfg.prefix_dir:
             from orion_tpu.serving.prefix_store import PrefixStore
 
@@ -448,6 +474,7 @@ class Server:
                 keep=cfg.prefix_keep,
                 should_abort=lambda: not self.health.accepting,
                 observer=self._on_prefix_io, clock=clock,
+                breaker=self._make_breaker("prefix"),
             )
             self.engine.attach_prefix_store(self.prefix_store)
         # the gauges we used to fly blind on — all callable (evaluated at
@@ -462,6 +489,8 @@ class Server:
                               lambda: len(self._sessions))
         self.metrics.gauge_fn("sessions_in_slots",
                               lambda: len(self._active_sessions))
+        self.metrics.gauge_fn("dirty_backlog",
+                              lambda: len(self._dirty_sessions))
         for label, jitted in _gen.DECODE_PROGRAMS.items():
             # host-side executable-cache introspection, not a device op —
             # the gauge that proves telemetry added zero compiles. The tp
@@ -547,6 +576,7 @@ class Server:
                 should_abort=lambda: not self.health.accepting,
                 observer=self._on_store_io, clock=clock,
                 identity=self._weights_identity,
+                breaker=self._make_breaker("session"),
             )
         self._sessions: "OrderedDict[str, SessionState]" = OrderedDict()
         self._session_last_use: Dict[str, float] = {}
@@ -556,6 +586,11 @@ class Server:
         # tick loop keeps retrying the save until disk catches up)
         self._dirty_sessions: set = set()
         self._dirty_retry_at: float = 0.0
+        # SIGTERM drain budget anchor: set when health enters DRAINING;
+        # a drain with dirty sessions holds residency (retrying via the
+        # breaker's half-open probes) until this deadline, then reports
+        # the still-dirty ids loudly and exits 0
+        self._drain_deadline: float = 0.0
         self._q: "queue.Queue[Pending]" = queue.Queue(maxsize=cfg.max_inflight)
         self._guard: Optional[PreemptionGuard] = None
         # submit() is documented thread-safe for feeder threads. The
@@ -629,12 +664,129 @@ class Server:
          else self._h_prefix_load_ms).observe(ms)
         self._c_prefix_bytes.inc(nbytes, labels={"op": op})
 
+    # -- storage failure domains (ISSUE 17) -----------------------------------
+
+    _BREAKER_GAUGE = {"closed": 0, "half_open": 1, "open": 2}
+
+    def _make_breaker(self, name: str) -> CircuitBreaker:
+        """One circuit breaker per shared store, on the server's clock,
+        registered for the health latch / status op / breaker_state
+        gauge. The observer runs OUTSIDE the breaker lock (breaker.py's
+        contract) so recording to the flight ring is safe."""
+        br = CircuitBreaker(
+            name,
+            consecutive_failures=max(self.cfg.breaker_failures, 1),
+            backoff=self.cfg.breaker_backoff,
+            max_backoff=self.cfg.breaker_max_backoff,
+            clock=self._clock, observer=self._on_breaker,
+        )
+        self._breakers[name] = br
+        self.metrics.gauge_fn(
+            "breaker_state",
+            lambda b=br: self._BREAKER_GAUGE[b.state],
+            labels={"store": name},
+        )
+        return br
+
+    def _on_breaker(self, name: str, old: str, new: str,
+                    reason: str) -> None:
+        """Breaker transition tap: every edge into the black box, and a
+        trip counts one store_errors tick (the windowed failure detail
+        lives in the breaker snapshot on /statusz)."""
+        self.flight.record("breaker", store=name, frm=old, to=new,
+                           reason=reason)
+        if new == "open":
+            self._c_store_errors.inc(labels={"store": name})
+
+    def _store_outage(self) -> Optional[str]:
+        """Name of a store whose breaker is not known-good (open or
+        probing), or None when all storage domains are healthy."""
+        for name, br in self._breakers.items():
+            if br.is_open:
+                return name
+        return None
+
+    def _store_outage_latched(self) -> bool:
+        """Must DEGRADED stay latched for storage reasons? True while
+        any breaker is open OR the dirty write-behind backlog from a
+        store outage has not drained — recovery to SERVING requires
+        both the store back AND every turn it missed on disk."""
+        if self._store_outage() is not None:
+            return True
+        return (self.health.reason.startswith("store-outage:")
+                and bool(self._dirty_sessions))
+
+    def _tick_store_health(self) -> None:
+        """Chunk-boundary storage-domain health: an open breaker drives
+        SERVING -> DEGRADED (reason ``store-outage:<store>`` — the
+        supervisor reads that reason and does NOT respawn: a fresh
+        process meets the same dead store); breakers closed AND dirty
+        backlog drained recovers to SERVING."""
+        self._probe_idle_breakers()
+        name = self._store_outage()
+        if name is not None:
+            reason = f"store-outage:{name}"
+            self._degrade(reason)
+            if (self.health.state is Health.DEGRADED
+                    and not self.health.reason.startswith("store-outage:")):
+                # already DEGRADED under a blunter reason (the save
+                # failure that tripped the breaker degraded first):
+                # sharpen it — the supervisor's respawn suppression and
+                # /healthz read the reason, and "store-outage:<name>"
+                # is the one that means "don't respawn, a fresh process
+                # meets the same dead store"
+                self.health.restate(reason)
+        elif (self.health.state is Health.DEGRADED
+              and self.health.reason.startswith("store-outage:")
+              and not self._dirty_sessions
+              and not self._slo_shedding):
+            self.health.to(Health.SERVING,
+                           "store recovered; dirty backlog drained")
+
+    def _probe_idle_breakers(self) -> None:
+        """Recovery evidence for a TRAFFIC-LESS outage: an open
+        breaker's probe normally rides real store work — the dirty-retry
+        sweep (session) or lookups and queued publishes (prefix) — but a
+        breaker that tripped with no such work pending has no probe
+        driver at all, so the replica would sit DEGRADED forever after
+        the store recovered. One cheap half-open directory scan per
+        dwell closes that hole; while the store is still dead the failed
+        probe re-opens with the doubled backoff, so an extended outage
+        costs one scan per dwell, not one per chunk. Stores whose
+        natural probe IS pending (dirty sessions, queued publishes)
+        are skipped — the real operation is the better probe."""
+        probes = []
+        if (self.prefix_store is not None
+                and not self.engine.pending_prefix_count):
+            probes.append(("prefix", self.prefix_store.list_keys))
+        if self.session_store is not None and not self._dirty_sessions:
+            probes.append(("session", self.session_store.list_sessions))
+        for name, scan in probes:
+            br = self._breakers.get(name)
+            if br is None or not br.is_open or not br.allow():
+                continue
+            try:
+                scan()
+            except OSError as e:
+                br.record_failure(f"probe: {type(e).__name__}: {e}")
+            else:
+                br.record_success()
+
     def _healthz(self) -> dict:
         """/healthz payload: the health snapshot stamped with the
         documented HTTP code for its state (health.HTTP_STATUS) — the
         code answers "route traffic here?", the body says why."""
         snap = self.health.snapshot()
         snap["code"] = HTTP_STATUS[Health(snap["state"])]
+        # the one-line answer a human (or a probe's log line) wants:
+        # the state, and WHY when the state needs explaining — e.g.
+        # "degraded: store-outage:session" tells the on-caller which
+        # failure domain to look at without a /statusz round trip
+        snap["status"] = (
+            snap["state"]
+            if snap["state"] == "serving" or not snap["reason"]
+            else f"{snap['state']}: {snap['reason']}"
+        )
         return snap
 
     def _statusz(self) -> dict:
@@ -673,6 +825,22 @@ class Server:
                     flat.get("attributed_ms_total", 0), 3
                 ),
                 "ledger_programs": len(self.cost_ledger.entries()),
+            }
+        if self._breakers:
+            # the failure-domain section: per-store breaker state (with
+            # probe countdowns), the dirty write-behind backlog against
+            # its bound, and the publish queue's counted drops — the
+            # page an operator reads DURING a store outage
+            flat = self.metrics.counters_flat()
+            snap["failure_domains"] = {
+                "breakers": {
+                    n: b.snapshot() for n, b in self._breakers.items()
+                },
+                "dirty_backlog": len(self._dirty_sessions),
+                "dirty_sessions": sorted(self._dirty_sessions)[:16],
+                "max_dirty_sessions": self.cfg.max_dirty_sessions,
+                "prefix_publish_drops": flat.get("prefix_publish_drops", 0),
+                "pending_prefix_publishes": self.engine.pending_prefix_count,
             }
         snap["flight_tail"] = self.flight.events()[-20:]
         return snap
@@ -914,6 +1082,10 @@ class Server:
             reason=reason,
         )
         self._c_health.inc(labels={"to": new.value})
+        if new is Health.DRAINING:
+            # anchor the drain budget: a drain holding dirty sessions
+            # through a store outage spends at most this long retrying
+            self._drain_deadline = self._clock() + self.cfg.grace
         if new in (Health.DEGRADED, Health.DRAINING, Health.DEAD):
             self.flight.dump(f"health-{new.value}")
 
@@ -981,6 +1153,11 @@ class Server:
             self._c_prefix_misses.inc()
         elif kind == "prefix_publish":
             self._c_prefix_publishes.inc()
+        elif kind == "prefix_drop":
+            # the bounded publish queue shed a novel prefix during a
+            # store outage: a counted drop (a later cold prefill), never
+            # a correctness event
+            self._c_prefix_drops.inc()
 
     # -- admission ------------------------------------------------------------
 
@@ -1105,6 +1282,7 @@ class Server:
                         for pending, result in self.engine.suspend_sessions():
                             self._complete(pending, result)
                     self._tick_sessions()
+                    self._tick_store_health()
                     self._tick_metrics()
                     self._tick_slo()
                     self._tick_cost()
@@ -1124,7 +1302,21 @@ class Server:
                         self.engine.publish_pending_prefixes()
                     if not self.engine.busy:
                         if (draining or drain_when_idle) and self._q.empty():
-                            break
+                            if not (draining and self._dirty_sessions
+                                    and self._clock()
+                                    < self._drain_deadline):
+                                break
+                            # drain mid-outage: DIRTY sessions are the
+                            # ONLY up-to-date copy of their conversations
+                            # — hold them resident through the grace
+                            # window, retrying saves via the breaker's
+                            # half-open probes (_tick_sessions above),
+                            # instead of silently dropping turns. The
+                            # deadline bounds the hold; whatever is
+                            # still dirty then is reported loudly on
+                            # the way out.
+                            time.sleep(min(max(cfg.poll, 0.001), 0.05))
+                            continue
                         try:
                             pending = self._q.get(timeout=cfg.poll)
                         except queue.Empty:
@@ -1161,6 +1353,28 @@ class Server:
                     self._maybe_drain(guard)
                     if self.health.state is Health.DRAINING:
                         self._reject_leftovers()
+                        if self._dirty_sessions:
+                            # the grace window ran out with saves still
+                            # failing: NEVER drop turns silently — name
+                            # the sessions whose last turn exists only
+                            # in this process's memory, in the warning,
+                            # the flight ring, and the DEAD dump below.
+                            # The exit code stays 0: the drain itself
+                            # completed; data at risk is an operator
+                            # page, not a crash.
+                            lost = sorted(self._dirty_sessions)
+                            self.flight.record(
+                                "drain_dirty", count=len(lost),
+                                sessions=lost[:16],
+                            )
+                            warnings.warn(
+                                f"drain exiting with {len(lost)} dirty "
+                                f"session(s) unsaved: {lost[:16]} — the "
+                                "store outage outlasted the grace "
+                                "window; their last turn is lost if "
+                                "this process's memory goes away",
+                                stacklevel=2,
+                            )
                         self.health.to(Health.DEAD, "drained")
                 # exposition on the way out, whatever the exit path:
                 # final metrics scrape + the trace file's tail (both
@@ -1290,6 +1504,23 @@ class Server:
                 self.engine.admit(
                     pending.request, tag=pending, deadline_at=deadline_at
                 )
+        except (OverloadError, StoreUnavailableError) as e:
+            # a RETRIABLE shed, never a failure: the turn was refused
+            # because the session store is down (a non-resident session
+            # needs a disk load nothing can serve right now) or the
+            # dirty write-behind backlog is at its bound. Nothing was
+            # lost — the conversation's last committed generation is
+            # intact wherever it lives — so the caller retries against
+            # another replica (one holding the session resident wins)
+            # or after recovery.
+            pending.error = (
+                e if isinstance(e, OverloadError)
+                else OverloadError(f"retriable: {e}")
+            )
+            self._bump("shed")
+            self.flight.record("session_shed", req=pending.rid,
+                               why=str(e))
+            self._finalize(pending, "shed")
         except Exception as e:
             # request isolation: an unadmittable request is an error
             # RESULT, never a dead process (and never a stuck batch) —
@@ -1331,6 +1562,20 @@ class Server:
             raise ValueError(
                 f"session {sid!r} is already resident in a slot; one turn "
                 "at a time per conversation"
+            )
+        cap = self.cfg.max_dirty_sessions
+        if (cap > 0 and sid not in self._dirty_sessions
+                and len(self._dirty_sessions) >= cap):
+            # write-behind bound: every turn served during a session-
+            # store outage becomes one more DIRTY pin this process could
+            # lose on a crash; at the bound, shed retriable instead of
+            # growing the at-risk set (sessions ALREADY dirty here keep
+            # serving — their risk exists either way, and affinity
+            # keeps their turns in order)
+            raise OverloadError(
+                f"session store not accepting writes and the dirty "
+                f"backlog is at its bound ({cap}): retry on another "
+                "replica or after the store recovers"
             )
         sess = self._session_lookup(sid)
         if sess is None:  # fresh conversation
@@ -1398,14 +1643,37 @@ class Server:
         sess = self._sessions.pop(sid, None)
         if sess is not None:
             self._session_last_use.pop(sid, None)
-            if (self.session_store is None or sid in self._dirty_sessions
-                    or sess.generation
-                    >= self.session_store.newest_generation(sid)):
+            if self.session_store is None or sid in self._dirty_sessions:
+                return sess
+            try:
+                newest = self.session_store.newest_generation(sid)
+            except (StoreUnavailableError, OSError):
+                # store outage: the staleness probe cannot run (breaker
+                # refusal, or the raw store error that is about to TRIP
+                # it — the probe was one breaker sample either way), and
+                # the resident copy is the best copy reachable ANYWHERE
+                # right now — serve it (outage affinity; the router
+                # prefers residency for the same reason). Single-writer-
+                # per-turn means a peer can only be ahead if a turn
+                # landed there, which the router avoids during outage.
+                return sess
+            if sess.generation >= newest:
                 return sess
             # stale: another replica advanced the conversation on disk
         if self.session_store is None:
             return None
-        return self.session_store.load(sid)
+        try:
+            return self.session_store.load(sid)
+        except OSError as e:
+            # a NON-resident session needs a disk read nothing can serve
+            # during an outage: surface it as the retriable store refusal
+            # (_admit sheds it; the conversation's committed generations
+            # are intact wherever the store lives) — an OSError here is
+            # store-shaped, unlike a corrupt-payload integrity error,
+            # which stays a per-request failure
+            raise StoreUnavailableError(
+                "session", f"{type(e).__name__}: {e}"
+            ) from e
 
     def _cache_session(self, sess: SessionState) -> None:
         self._sessions[sess.session_id] = sess
@@ -1438,6 +1706,12 @@ class Server:
                 self.session_store.save(sess)
                 self._bump("session_saves")
             self._dirty_sessions.discard(sess.session_id)
+        except StoreUnavailableError:
+            # breaker open: refused in O(1) before any disk syscall, and
+            # the trip itself already hit the flight ring + health latch
+            # — a warning per turn would be outage spam. DIRTY pin; the
+            # tick loop's retry rides the breaker's half-open probe.
+            self._dirty_sessions.add(sess.session_id)
         except Exception as e:
             warnings.warn(
                 f"session {sess.session_id} save failed "
@@ -1446,31 +1720,48 @@ class Server:
                 "this turn",
                 stacklevel=2,
             )
+            self._c_store_errors.inc(labels={"store": "session"})
             self._dirty_sessions.add(sess.session_id)
             self._degrade(f"session save failed: {type(e).__name__}")
         self._cache_session(sess)
 
     def _tick_sessions(self) -> None:
-        """Chunk-boundary cache maintenance: retry dirty sessions' saves
-        (throttled — a persistently failing store must not spend its
-        whole retry backoff budget at every chunk boundary), and drop
-        CLEAN resident entries idle past the timeout (those are already
-        on disk — eviction frees host memory, it never loses state;
-        dirty entries stay pinned until their save lands)."""
+        """Chunk-boundary cache maintenance: retry dirty sessions' saves,
+        and drop CLEAN resident entries idle past the timeout (those are
+        already on disk — eviction frees host memory, it never loses
+        state; dirty entries stay pinned until their save lands).
+
+        The dirty retry RIDES THE BREAKER: while the session breaker is
+        open and the probe is not due, the whole sweep is one O(1) host
+        check — no disk syscalls, no retry backoff burned on the
+        scheduler thread at every boundary. When the probe IS due, the
+        first save attempt is the half-open probe: success closes the
+        breaker and the same sweep drains the rest of the backlog;
+        failure re-opens it (backoff doubled) and the sweep stops at the
+        first StoreUnavailableError. Without a breaker (dirty from a
+        transient non-outage failure) the old time throttle applies."""
         now = self._clock()
-        if (self.session_store is not None and self._dirty_sessions
-                and now >= self._dirty_retry_at):
-            self._dirty_retry_at = now + max(1.0, self.cfg.poll)
-            for sid in list(self._dirty_sessions):
-                sess = self._sessions.get(sid)
-                if sess is None or sid in self._active_sessions:
-                    continue
-                try:
-                    self.session_store.save(sess)
-                    self._bump("session_saves")
-                    self._dirty_sessions.discard(sid)
-                except Exception:
-                    continue  # still dirty, still pinned; retry later
+        if self.session_store is not None and self._dirty_sessions:
+            br = self.session_store.breaker
+            retry_now = now >= self._dirty_retry_at
+            if br is not None and br.blocked():
+                retry_now = False  # outage confirmed, probe not due
+            elif br is not None and br.is_open:
+                retry_now = True  # probe due: one save IS the probe
+            if retry_now:
+                self._dirty_retry_at = now + max(1.0, self.cfg.poll)
+                for sid in list(self._dirty_sessions):
+                    sess = self._sessions.get(sid)
+                    if sess is None or sid in self._active_sessions:
+                        continue
+                    try:
+                        self.session_store.save(sess)
+                        self._bump("session_saves")
+                        self._dirty_sessions.discard(sid)
+                    except StoreUnavailableError:
+                        break  # probe failed/refused: stop the sweep now
+                    except Exception:
+                        continue  # still dirty, still pinned; retry later
         idle = self.cfg.session_idle_s
         if idle <= 0 or not self._sessions:
             return
@@ -1563,7 +1854,9 @@ class Server:
                 f"request needed the ladder (rewinds={result.rewinds}, "
                 f"reprefills={result.reprefills}, status={result.status})"
             )
-        elif self.health.state is Health.DEGRADED and not self._slo_shedding:
+        elif (self.health.state is Health.DEGRADED
+              and not self._slo_shedding
+              and not self._store_outage_latched()):
             # the SLO latch holds DEGRADED while the burn persists:
             # without the gate, clean-but-slow completions would flap
             # DEGRADED<->SERVING once per request — and every re-entry
@@ -1647,6 +1940,13 @@ class Server:
             snap["sessions"] = {
                 "resident": len(self._sessions),
                 "in_slots": len(self._active_sessions),
+                "dirty": len(self._dirty_sessions),
+                # the ids ride the status op for the router's outage
+                # affinity: a session-carrying turn during a store
+                # outage must land on the replica already holding that
+                # session resident (anywhere else is a guaranteed shed).
+                # Bounded by max_resident_sessions, so the payload is.
+                "resident_ids": list(self._sessions),
             }
             snap["queued"] = self._q.qsize()
             # the SLO state rides the snapshot so the fleet layer can

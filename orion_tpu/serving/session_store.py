@@ -50,6 +50,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from orion_tpu.resilience.breaker import CircuitBreaker, StoreUnavailableError
 from orion_tpu.resilience.inject import fire
 from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
 from orion_tpu.training.checkpoint import (
@@ -177,6 +178,16 @@ class SessionStore:
     ``keep``: retained generations per session (the newest is live, the
     rest are fallback targets for a damaged latest). ``should_abort``:
     polled by the retry layer — see :func:`resilience.retry.call_with_retries`.
+
+    ``breaker``: optional :class:`resilience.breaker.CircuitBreaker`
+    guarding the shared store as a failure domain. Each public operation
+    (save / load / generations scan) is ONE breaker sample — retries
+    included — and while the breaker is open every operation raises
+    :class:`resilience.breaker.StoreUnavailableError` in O(1) host work
+    before any disk syscall (the ``_io_*`` helpers below are the module's
+    only filesystem touch points; lint rule ``raw-store-io`` enforces
+    that). The half-open probe rides whichever operation wins
+    ``allow()`` first — in the server that is the dirty-session retry.
     """
 
     def __init__(
@@ -188,6 +199,7 @@ class SessionStore:
         observer: Optional[Callable[[str, float], None]] = None,
         clock: Callable[[], float] = time.monotonic,
         identity: Optional[str] = None,
+        breaker: Optional[CircuitBreaker] = None,
     ):
         assert keep >= 1, keep
         self.directory = os.path.abspath(directory)
@@ -208,6 +220,7 @@ class SessionStore:
         # be host-only (obs-device-sync covers registered hooks).
         self._observer = observer
         self._clock = clock
+        self.breaker = breaker
         os.makedirs(self.directory, exist_ok=True)
 
     def _observe(self, op: str, t0: float) -> None:
@@ -216,6 +229,62 @@ class SessionStore:
                 self._observer(op, (self._clock() - t0) * 1e3)
             except Exception:
                 pass  # telemetry must never fail the I/O it measures
+
+    # -- breaker gate and raw I/O ---------------------------------------------
+    # The ``_io_*`` helpers are this module's ONLY direct filesystem
+    # touch points (lint rule ``raw-store-io``): each fails fast with
+    # StoreUnavailableError while the breaker is open-and-not-probing,
+    # so during an outage a store touch costs one lock + one clock read,
+    # never a blocking syscall against dead storage. Operation-level
+    # accounting (``_enter``/``_exit``) wraps whole public operations —
+    # one completed save/load/scan, retries included, is one breaker
+    # sample.
+
+    def _enter(self) -> None:
+        if self.breaker is not None and not self.breaker.allow():
+            raise StoreUnavailableError("session")
+
+    def _exit(self, ok: bool, reason: str = "") -> None:
+        if self.breaker is None:
+            return
+        if ok:
+            self.breaker.record_success()
+        else:
+            self.breaker.record_failure(reason)
+
+    def _blocked_check(self) -> None:
+        if self.breaker is not None and self.breaker.blocked():
+            raise StoreUnavailableError("session")
+
+    def _io_open(self, path: str, mode: str = "r", **kw):
+        self._blocked_check()
+        return open(path, mode, **kw)
+
+    def _io_listdir(self, path: str) -> List[str]:
+        """Directory scan, or [] for a path that doesn't exist (a session
+        never saved) — missing is a normal answer, not a store fault."""
+        self._blocked_check()
+        fire("serve.session_scan")
+        try:
+            return os.listdir(path)
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+
+    def _io_replace(self, src: str, dst: str) -> None:
+        self._blocked_check()
+        os.replace(src, dst)
+
+    def _io_makedirs(self, path: str) -> None:
+        self._blocked_check()
+        os.makedirs(path, exist_ok=True)
+
+    def _io_remove(self, path: str) -> None:
+        self._blocked_check()
+        os.remove(path)
+
+    def _io_rmdir(self, path: str) -> None:
+        self._blocked_check()
+        os.rmdir(path)
 
     # -- paths ----------------------------------------------------------------
 
@@ -235,20 +304,36 @@ class SessionStore:
     def _json(d: str, gen: int) -> str:
         return os.path.join(d, f"gen-{gen:06d}.json")
 
-    def generations(self, session_id: str) -> List[int]:
-        """COMMITTED generations (manifest present), oldest first. A
-        ``.bin`` without its ``.json`` is a torn save and is invisible."""
-        d = self._dir(session_id)
-        if not os.path.isdir(d):
-            return []
+    def _scan(self, d: str) -> List[int]:
+        """COMMITTED generations under ``d`` (manifest present), oldest
+        first. A ``.bin`` without its ``.json`` is a torn save and is
+        invisible. Internal: no operation accounting — save/load/
+        generations wrap it as part of THEIR breaker sample."""
         out = []
-        for name in os.listdir(d):
+        for name in self._io_listdir(d):
             if name.startswith("gen-") and name.endswith(".json"):
                 try:
                     out.append(int(name[len("gen-"):-len(".json")]))
                 except ValueError:
                     continue
         return sorted(out)
+
+    def generations(self, session_id: str) -> List[int]:
+        """Committed generations of one session, oldest first — one
+        breaker-sampled store operation (the staleness probe a
+        shared-store replica pays per session lookup). Raises
+        StoreUnavailableError while the breaker is open instead of
+        touching the directory."""
+        self._enter()
+        try:
+            out = self._scan(self._dir(session_id))
+        except StoreUnavailableError:
+            raise
+        except OSError as e:
+            self._exit(False, f"scan: {type(e).__name__}")
+            raise
+        self._exit(True)
+        return out
 
     def newest_generation(self, session_id: str) -> int:
         """Newest committed generation number (0 = never saved) — the
@@ -259,9 +344,8 @@ class SessionStore:
 
     def list_sessions(self) -> List[str]:
         return sorted(
-            n for n in os.listdir(self.directory)
-            if os.path.isdir(os.path.join(self.directory, n))
-            and self.generations(n)
+            n for n in self._io_listdir(self.directory)
+            if self._scan(os.path.join(self.directory, n))
         )
 
     # -- save -----------------------------------------------------------------
@@ -270,9 +354,25 @@ class SessionStore:
         """Persist one new generation; returns its number. Write order is
         payload-then-manifest, each atomically renamed into place, so the
         manifest publish is the commit point: a kill ANYWHERE mid-save
-        leaves the previous generation the newest committed one."""
+        leaves the previous generation the newest committed one.
+
+        One breaker sample per call (scan + retried write together);
+        raises StoreUnavailableError with no disk syscalls while the
+        breaker is open — the server maps that to a DIRTY pin."""
+        self._enter()
+        try:
+            return self._save_op(state)
+        except StoreUnavailableError:
+            raise
+        except OSError as e:
+            self._exit(False, f"save: {type(e).__name__}")
+            raise
+        # non-OSError exceptions are corruption/bug-shaped, not outage
+        # evidence: they propagate without a breaker sample
+
+    def _save_op(self, state: SessionState) -> int:
         d = self._dir(state.session_id)
-        gens = self.generations(state.session_id)
+        gens = self._scan(d)
         gen = (gens[-1] if gens else 0) + 1
         payload = state.arrays()
         leaves: List[np.ndarray] = []
@@ -303,11 +403,11 @@ class SessionStore:
 
         def _write():
             fire("serve.session_save", step=gen)
-            os.makedirs(d, exist_ok=True)
+            self._io_makedirs(d)
             tmp = self._bin(d, gen) + ".tmp"
-            with open(tmp, "wb") as f:
+            with self._io_open(tmp, "wb") as f:
                 f.write(blob)
-            os.replace(tmp, self._bin(d, gen))
+            self._io_replace(tmp, self._bin(d, gen))
             atomic_write_json(self._json(d, gen), doc)  # commit point
 
         t0 = self._clock()
@@ -316,6 +416,7 @@ class SessionStore:
             describe=f"session save ({state.session_id} gen {gen})",
             should_abort=self._should_abort,
         )
+        self._exit(True)
         self._observe("save", t0)
         state.generation = gen
         self._gc(d, keep_from=gen)
@@ -326,19 +427,23 @@ class SessionStore:
         stranded tmp files. Advisory, like manifest GC: a failure here is
         retried implicitly by the next save."""
         floor = keep_from - self.keep + 1
-        for name in os.listdir(d):
+        try:
+            names = self._io_listdir(d)
+        except (OSError, StoreUnavailableError):
+            return  # advisory: the next save after recovery re-runs it
+        for name in names:
             path = os.path.join(d, name)
             try:
                 if name.endswith(".tmp"):
-                    os.remove(path)
+                    self._io_remove(path)
                     continue
                 if not name.startswith("gen-"):
                     continue
                 stem = name.split(".", 1)[0]
                 gen = int(stem[len("gen-"):])
                 if gen < floor:
-                    os.remove(path)
-            except (OSError, ValueError):
+                    self._io_remove(path)
+            except (OSError, ValueError, StoreUnavailableError):
                 continue
 
     # -- load -----------------------------------------------------------------
@@ -349,8 +454,24 @@ class SessionStore:
         back to the previous committed generation with a loud warning
         (progress since that save is lost — the tokens already returned
         to the client may run ahead of the restored ``served``); when no
-        generation verifies, raises :class:`SessionIntegrityError`."""
-        gens = self.generations(session_id)
+        generation verifies, raises :class:`SessionIntegrityError`.
+
+        One breaker sample per call; StoreUnavailableError (no disk
+        syscalls) while the breaker is open — the server maps that to a
+        retriable shed for non-resident sessions."""
+        self._enter()
+        try:
+            out = self._load_op(session_id)
+        except StoreUnavailableError:
+            raise
+        except OSError as e:
+            self._exit(False, f"load: {type(e).__name__}")
+            raise
+        self._exit(True)
+        return out
+
+    def _load_op(self, session_id: str) -> Optional[SessionState]:
+        gens = self._scan(self._dir(session_id))
         if not gens:
             return None
         t0 = self._clock()
@@ -377,6 +498,13 @@ class SessionStore:
                 )
             self._observe("load", t0)
             return state
+        # Total failure: distinguish outage from corruption. If any
+        # generation died with an OSError the store itself is suspect —
+        # surface THAT (a breaker sample, a retriable condition), not an
+        # integrity verdict that would fail the turn permanently.
+        os_errs = [e for _, e in failures if isinstance(e, OSError)]
+        if os_errs:
+            raise os_errs[-1]
         raise SessionIntegrityError(
             f"no intact generation for session {session_id}; tried "
             + ", ".join(f"{g} ({type(e).__name__})" for g, e in failures)
@@ -387,9 +515,9 @@ class SessionStore:
 
         def _read():
             fire("serve.session_load", step=gen)
-            with open(self._json(d, gen)) as f:
+            with self._io_open(self._json(d, gen)) as f:
                 doc = json.load(f)
-            with open(self._bin(d, gen), "rb") as f:
+            with self._io_open(self._bin(d, gen), "rb") as f:
                 blob = f.read()
             return doc, blob
 
@@ -442,16 +570,18 @@ class SessionStore:
 
     def delete(self, session_id: str) -> None:
         d = self._dir(session_id)
-        if not os.path.isdir(d):
-            return
-        for name in os.listdir(d):
+        try:
+            names = self._io_listdir(d)
+        except (OSError, StoreUnavailableError):
+            return  # best-effort, like _gc
+        for name in names:
             try:
-                os.remove(os.path.join(d, name))
-            except OSError:
+                self._io_remove(os.path.join(d, name))
+            except (OSError, StoreUnavailableError):
                 pass
         try:
-            os.rmdir(d)
-        except OSError:
+            self._io_rmdir(d)
+        except (OSError, StoreUnavailableError):
             pass
 
 

@@ -147,6 +147,11 @@ _SLOW = {
     "test_quant.py::test_quant_greedy_token_equality_trained",
     "test_quant.py::test_quant_prequantized_reuse",
     "test_quant.py::test_quant_cast_params_noop",
+    # ISSUE 17 storage failure domains (>=10s): the sampled full-outage
+    # acceptance variant runs in the full tier; the quick tier keeps the
+    # greedy variant (same outage walk, same bitwise contract) plus every
+    # breaker/regime/fail-fast unit
+    "test_storage_domains.py::test_store_outage_zero_failures_bitwise[sampled]",
     # regenerated after the jax-compat repair (utils/compat.py): these used
     # to fail in milliseconds on the shard_map/pvary/axis_size imports and
     # now run to completion; all measured >=10s on this box

@@ -425,7 +425,48 @@ def checkpoint_meta(path, blob):
     os.rename(path + ".tmp", path)
 """,
     ),
+    (
+        "raw-store-io",
+        "orion_tpu/serving/session_store.py",
+        """
+import os
+
+def newest_generation(d):
+    return sorted(os.listdir(d))[-1]  # raw syscall: no breaker gate
+""",
+        """
+import os
+
+def _io_listdir(d):
+    # breaker-gated helper: blocked() checked before the syscall
+    return os.listdir(d)
+
+def newest_generation(d):
+    return sorted(_io_listdir(d))[-1]
+""",
+    ),
 ]
+
+
+def test_raw_store_io_scoped_to_store_modules():
+    """The same raw listdir in any OTHER serving module is not a finding —
+    the rule encodes the _io_* discipline of the two shared-storage
+    clients, whose syscalls must all pass the circuit-breaker gate."""
+    src = """
+import os
+
+def scan(d):
+    return os.listdir(d)
+"""
+    assert "raw-store-io" in rule_ids(
+        lint_source(src, path="orion_tpu/serving/prefix_store.py")
+    )
+    assert "raw-store-io" not in rule_ids(
+        lint_source(src, path="orion_tpu/serving/server.py")
+    )
+    assert "raw-store-io" not in rule_ids(
+        lint_source(src, path="tests/test_dummy.py")
+    )
 
 
 def test_non_atomic_persist_scoped_to_persistence_subtrees():

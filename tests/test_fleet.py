@@ -450,6 +450,80 @@ def test_supervisor_drains_and_respawns_degraded_replica(mp, tmp_path):
         sup.drain_all(timeout=30.0)
 
 
+class ScriptedStatusReplica(FakeReplica):
+    """FakeReplica plus the status/lifecycle surface Supervisor.tick
+    drives: a scripted (state, reason) heartbeat and drain/kill
+    recorders."""
+
+    def __init__(self, name, state="serving", reason=""):
+        super().__init__(name, state=state)
+        self.reason = reason
+        self.drained = False
+        self.killed = False
+
+    def wait_ready(self, timeout):
+        pass
+
+    def status(self, timeout=2.0):
+        return {"state": self.health_state(), "reason": self.reason}
+
+    def drain(self):
+        self.drained = True
+        self._alive = False
+        self._state = "dead"
+
+    def kill(self):
+        self.killed = True
+        self._alive = False
+
+    def join(self, timeout=0.0):
+        return not self._alive
+
+
+def test_supervisor_suppresses_respawn_for_store_outage():
+    """ISSUE 17 regression: a replica DEGRADED with reason
+    ``store-outage:<store>`` must NOT be drained-and-respawned — a fresh
+    process meets the same dead store, minus this one's dirty
+    write-behind sessions (the only up-to-date turns during the outage).
+    The suppression is logged once per outage episode; any OTHER
+    degraded reason still takes the drain-and-respawn path."""
+    spawned = []
+
+    def factory(name):
+        r = ScriptedStatusReplica(name)
+        spawned.append(r)
+        return r
+
+    sup = Supervisor(factory, 1, spawn_retry=FAST_RETRY,
+                     drain_grace=0.1).start()
+    r0 = spawned[0]
+    r0._state = "degraded"
+    r0.reason = "store-outage:session"
+    sup.tick()
+    sup.tick()  # second heartbeat of the same episode: no new event
+    assert sup.replicas[0] is r0, "store-outage replica must keep its slot"
+    assert not r0.drained and not r0.killed and len(spawned) == 1
+    msgs = [e[2] for e in sup.events]
+    assert sum("respawn_suppressed" in m for m in msgs) == 1
+    assert any("store-outage:session" in m for m in msgs)
+    # recovery closes the episode; a NEW outage is logged again
+    r0._state = "serving"
+    r0.reason = ""
+    sup.tick()
+    r0._state = "degraded"
+    r0.reason = "store-outage:prefix"
+    sup.tick()
+    msgs = [e[2] for e in sup.events]
+    assert sum("respawn_suppressed" in m for m in msgs) == 2
+    assert sup.replicas[0] is r0 and len(spawned) == 1
+    # control: degraded for a non-storage reason still drains-and-respawns
+    r0.reason = "watchdog: serve loop stalled"
+    sup.tick()
+    assert r0.drained, "non-storage degradation takes the drain path"
+    assert sup.replicas[0] is not r0 and len(spawned) == 2
+    assert any("degraded; draining" in e[2] for e in sup.events)
+
+
 def test_fleet_overload_shed_integration(mp, tmp_path):
     """Fleet-level admission over real replicas: max_inflight=1 with a
     long request in flight sheds the second submit at the door."""

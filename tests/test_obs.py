@@ -915,6 +915,50 @@ def test_healthz_code_tracks_every_health_transition(mp, tmp_path):
         _get(url + "/healthz", timeout=1.0)  # endpoint down after close
 
 
+def test_healthz_body_carries_store_outage_reason(mp, tmp_path):
+    """ISSUE 17: a load balancer polling /healthz during a store outage
+    must see WHY the replica is degraded — the body's ``status`` field
+    carries the failure-domain reason (``degraded: store-outage:session``)
+    while the code stays 200 (degraded still serves), and the status
+    returns to plain ``serving`` once the breaker closes."""
+    model, params = mp
+    cfg = _cfg(tmp_path, metrics_port=0,
+               session_dir=str(tmp_path / "sessions"),
+               breaker_failures=1, breaker_backoff=0.02,
+               breaker_max_backoff=0.05)
+    srv = Server(model, params, cfg)
+    url = f"http://127.0.0.1:{srv.http_port}"
+    try:
+        srv.submit(DecodeRequest(prompt=_prompt(0), max_new_tokens=4,
+                                 sample=GREEDY, seed=0))
+        assert srv.serve(drain_when_idle=True) == 0
+        code, body = _get(url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "serving"
+        # the session store dies: one failure trips the breaker, the
+        # next health sweep latches DEGRADED with the domain reason
+        br = srv.session_store.breaker
+        br.record_failure("induced outage")
+        assert srv.serve(drain_when_idle=True) == 0
+        code, body = _get(url + "/healthz")
+        payload = json.loads(body)
+        assert code == HTTP_STATUS[Health.DEGRADED] == 200
+        assert payload["state"] == "degraded"
+        assert payload["status"] == "degraded: store-outage:session"
+        # recovery: past the backoff the half-open probe succeeds, the
+        # breaker closes, and the next sweep restores plain "serving"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and br.state != "closed":
+            if br.allow():
+                br.record_success()
+            time.sleep(0.01)
+        assert br.state == "closed"
+        assert srv.serve(drain_when_idle=True) == 0
+        code, body = _get(url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "serving"
+    finally:
+        srv.close()
+
+
 def test_live_scrape_mid_stream_adds_zero_compiles(mp, tmp_path):
     """The zero-cost acceptance: serving with the HTTP endpoint live and
     scraped mid-stream (every ~20 ms, all four routes) leaves all four

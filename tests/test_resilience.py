@@ -185,6 +185,30 @@ def test_every_registered_chaos_site_is_exercised():
         )
 
 
+def test_every_regime_kind_is_exercised():
+    """Meta-test against dead regime kinds (ISSUE 17): every sustained
+    fault-regime kind in inject.REGIME_KINDS must appear as a
+    double-quoted literal in at least one chaos-marked test module — a
+    kind added to the fault model without a chaos test that arms it
+    fails HERE."""
+    test_dir = os.path.dirname(__file__)
+    corpus = {}
+    for name in sorted(os.listdir(test_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(test_dir, name)) as f:
+                text = f.read()
+            if "pytest.mark.chaos" in text:
+                corpus[name] = text
+    assert corpus, "no chaos-marked test modules found"
+    for kind in inject.REGIME_KINDS:
+        needle = f'"{kind}"'
+        hits = [name for name, text in corpus.items() if needle in text]
+        assert hits, (
+            f"regime kind {kind!r} is registered in resilience/inject.py "
+            "but no chaos test arms it — cover it or retire the kind"
+        )
+
+
 def test_every_registered_site_delivery_leaves_flight_event():
     """Site⇄event parity (ISSUE 9): EVERY registered injection site —
     static names and dynamic prefix families alike — must leave a

@@ -1,0 +1,615 @@
+"""Storage failure domains (ISSUE 17): circuit breakers, sustained
+outage regimes, and store-outage graceful degradation.
+
+The acceptance proofs live here — (1) a FULL shared-store outage
+(session + prefix) mid-conversation produces ZERO failed requests and
+zero lost turns: sessions serve from their resident copies (write-behind
+DIRTY pins), prefix lookups degrade to cold prefill, and after the store
+recovers the concatenated outputs are BITWISE-equal to uninterrupted
+runs, greedy and sampled; (2) while a breaker is open every store touch
+is O(1) host work — the fault plan's delivery log stays FROZEN because
+no syscall ever reaches a fire point, so a 2s-per-op latency brownout
+costs nothing; (3) the dirty write-behind backlog is bounded: at the cap
+new session admissions shed with a retriable OverloadError while
+already-dirty sessions keep serving; (4) SIGTERM mid-outage holds the
+drain through the grace window, then reports the unsaved sessions loudly
+and still exits 0 — data at risk is an operator page, not a crash.
+
+Plus the breaker state machine itself (fake clock: trip, dwell, half-open
+probe, backoff doubling) and the sustained-regime fault model (window
+semantics, every kind in REGIME_KINDS, validation).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.generate import SampleConfig, generate
+from orion_tpu.models.configs import ModelConfig
+from orion_tpu.models.transformer import TransformerLM
+from orion_tpu.resilience import inject
+from orion_tpu.resilience.breaker import CircuitBreaker, StoreUnavailableError
+from orion_tpu.resilience.retry import RetryPolicy
+from orion_tpu.serving import (
+    DecodeRequest,
+    Health,
+    OverloadError,
+    ServeConfig,
+    Server,
+    SessionState,
+    SessionStore,
+)
+from orion_tpu.serving.prefix_store import PrefixStore
+
+pytestmark = pytest.mark.chaos
+
+# same shape family as tests/test_sessions.py (one layer of each type) so
+# the decode/prefill programs share the process-wide jit caches
+CFG = ModelConfig(
+    name="session_test", vocab_size=64, d_model=32, n_layers=3, n_heads=2,
+    layer_types=("linear", "softmax", "swa"), window=4, max_seq_len=96,
+    dtype="float32", backend="xla",
+)
+GREEDY = SampleConfig(temperature=0.0)
+SAMPLED = SampleConfig(temperature=0.8, top_k=5, top_p=0.9, eos_token=3,
+                       pad_token=0)
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = TransformerLM(CFG)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _prompt(i, ln=5):
+    return jax.random.randint(
+        jax.random.PRNGKey(2000 + i), (1, ln), 0, CFG.vocab_size
+    ).astype(jnp.int32)
+
+
+def _ref(mp, prompt, n_new, sample, seed):
+    model, params = mp
+    return np.asarray(
+        generate(model, params, prompt, n_new, sample,
+                 rng=jax.random.PRNGKey(seed))
+    )
+
+
+def _shared_prefix_prompt(suffix_seed, prefix_len=24, suffix_len=5):
+    prefix = jax.random.randint(
+        jax.random.PRNGKey(7), (1, prefix_len), 0, CFG.vocab_size
+    )
+    suffix = jax.random.randint(
+        jax.random.PRNGKey(9000 + suffix_seed), (1, suffix_len), 0,
+        CFG.vocab_size,
+    )
+    return np.concatenate(
+        [np.asarray(prefix), np.asarray(suffix)], axis=1
+    ).astype(np.int32)
+
+
+def _serve_cfg(tmp_path, **kw):
+    kw.setdefault("chunk", 4)
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_inflight", 8)
+    kw.setdefault("session_dir", str(tmp_path / "sessions"))
+    return ServeConfig(**kw)
+
+
+def _run_turn(srv, prompt, want, sample, seed, sid):
+    p = srv.submit(DecodeRequest(
+        prompt=prompt, max_new_tokens=want, sample=sample, seed=seed,
+        session_id=sid,
+    ))
+    assert srv.serve(drain_when_idle=True) == 0
+    return p
+
+
+def _cont():
+    return np.zeros((1, 0), np.int32)
+
+
+def _fake_session(sid="alice", seed=7, served=0, n_emitted=6):
+    state = [
+        {"s": np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4) / 7,
+         "z": np.ones((1, 2, 3), np.float32)},
+        {"k": np.full((1, 2, 4, 3), 0.5, np.float32),
+         "v": np.zeros((1, 2, 4, 3), np.float32)},
+    ]
+    return SessionState(
+        session_id=sid, seed=seed, sample=SAMPLED, served=served,
+        token=np.array([9], np.int32), state=state,
+        t=np.array(11, np.int32), emit=np.array(n_emitted, np.int32),
+        done=np.array([False]),
+        prompt=np.arange(5, dtype=np.int32)[None],
+        emitted=np.arange(n_emitted, dtype=np.int32)[None],
+    )
+
+
+# ---------------------------------------------------------------------------
+# the breaker state machine, on a fake clock
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trip_probe_recover_fake_clock():
+    """closed -> open (consecutive), dwell, half-open single probe,
+    probe success closes; a later failed probe doubles the backoff."""
+    t = [0.0]
+    seen = []
+    br = CircuitBreaker(
+        "session", consecutive_failures=2, backoff=1.0, jitter=0.0,
+        clock=lambda: t[0],
+        observer=lambda name, old, new, why: seen.append((old, new)),
+    )
+    assert br.state == "closed" and br.allow() and not br.blocked()
+    br.record_failure("scan: OSError")
+    assert br.state == "closed"  # one failure is not an outage
+    br.record_failure("scan: OSError")
+    assert br.state == "open" and br.is_open
+    assert br.blocked() and not br.allow()
+    snap = br.snapshot()
+    assert snap["state"] == "open"
+    assert snap["probe_in_secs"] == pytest.approx(1.0)  # jitter=0: exact
+    assert snap["reason"]
+    t[0] = 0.5
+    assert br.blocked() and not br.allow()  # dwell not over
+    t[0] = 1.01
+    assert not br.blocked()  # per-syscall check admits the probe window
+    assert br.allow()        # exactly ONE half-open probe
+    assert br.state == "half_open"
+    assert not br.allow()    # concurrent operation refused while probing
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+    # trip again: first dwell is backoff (trips reset on close), a FAILED
+    # probe doubles it
+    br.record_failure()
+    br.record_failure()
+    assert br.is_open
+    t[0] = 2.2  # past opened_at (1.01) + 1.0
+    assert br.allow()
+    br.record_failure("probe failed")
+    assert br.state == "open"
+    assert br.snapshot()["probe_in_secs"] == pytest.approx(2.0)
+    assert ("closed", "open") in seen and ("open", "half_open") in seen
+    assert ("half_open", "closed") in seen and ("half_open", "open") in seen
+
+
+def test_breaker_windowed_failure_rate_trips():
+    """The rate trip catches a flapping store that never fails
+    consecutively enough for the fast path."""
+    t = [0.0]
+    br = CircuitBreaker(
+        "prefix", consecutive_failures=100, window=8, min_samples=8,
+        failure_rate=0.5, backoff=1.0, jitter=0.0, clock=lambda: t[0],
+    )
+    for _ in range(3):  # F S F S F S: 6 samples, under min_samples
+        br.record_failure()
+        br.record_success()
+    assert br.state == "closed"
+    br.record_failure()
+    assert br.state == "closed"  # 7 samples: still under min_samples
+    br.record_failure()          # 8 samples, 5 failures: rate >= 0.5
+    assert br.state == "open"
+    assert "operations failed" in br.snapshot()["reason"]
+
+
+def test_breaker_open_straggler_success_is_ignored():
+    """A success from an operation that started before the trip must not
+    close the breaker — the half-open probe is the only sanctioned
+    evidence of recovery."""
+    t = [0.0]
+    br = CircuitBreaker("session", consecutive_failures=1, backoff=1.0,
+                        jitter=0.0, clock=lambda: t[0])
+    br.record_failure()
+    assert br.state == "open"
+    br.record_success()  # straggler
+    assert br.state == "open" and br.blocked()
+
+
+# ---------------------------------------------------------------------------
+# sustained fault regimes: window semantics, every kind, validation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["eio", "enospc", "latency", "partition"])
+def test_regime_window_semantics(kind):
+    """A regime is live while the regime clock (last step fired at the
+    clock site) sits in [from_step, until_step): inert before, delivering
+    inside, recovered after. ``latency`` sleeps (injectably) and then
+    SUCCEEDS — the brownout with no error surfacing."""
+    sleeps = []
+    plan = inject.FaultPlan()
+    plan.sleep = sleeps.append
+    plan.degrade_site("serve.session_save", kind=kind, from_step=2,
+                      until_step=4, latency=0.123)
+    with inject.inject(plan):
+        inject.fire("serve.session_save", step=0)  # clock reads 0 < 2
+        assert plan.delivered == []
+        inject.fire("serve.chunk_delay", step=2)   # clock -> 2: window open
+        if kind == "latency":
+            inject.fire("serve.session_save", step=0)
+            assert sleeps == [0.123]
+        else:
+            with pytest.raises(OSError) as ei:
+                inject.fire("serve.session_save", step=0)
+            assert ei.value.errno == inject._REGIME_ERRNO[kind]
+            assert kind in str(ei.value)
+        assert len(plan.delivered) == 1
+        inject.fire("serve.chunk_delay", step=4)   # clock -> 4: window shut
+        inject.fire("serve.session_save", step=0)
+        assert len(plan.delivered) == 1  # recovered: nothing delivered
+
+
+def test_regime_one_shot_takes_precedence():
+    """An armed one-shot at the same site fires INSTEAD of the regime —
+    regimes layer under point faults, so a test can place a specific
+    error inside a broader outage."""
+    plan = inject.FaultPlan().degrade_site("serve.session_save", kind="eio")
+    plan.fail_io("serve.session_save", exc=ValueError, msg="one-shot wins")
+    with inject.inject(plan):
+        with pytest.raises(ValueError, match="one-shot wins"):
+            inject.fire("serve.session_save")
+        with pytest.raises(OSError):  # one-shot consumed: regime resumes
+            inject.fire("serve.session_save")
+
+
+def test_regime_validation_rejects_misarmed_plans():
+    plan = inject.FaultPlan()
+    with pytest.raises(ValueError, match="unknown regime kind"):
+        plan.degrade_site("serve.session_", kind="flood")
+    with pytest.raises(ValueError, match="covers no registered"):
+        plan.degrade_site("serve.sesion_")  # typo'd: would never deliver
+    with pytest.raises(ValueError, match="empty regime window"):
+        plan.degrade_site("serve.session_", from_step=3, until_step=3)
+    with pytest.raises(ValueError, match="unknown regime clock site"):
+        plan.degrade_site("serve.session_", clock_site="nope")
+
+
+def test_store_scan_sites_fire(tmp_path):
+    """The directory-scan sites exist and fire where the stores actually
+    list their directories — a regime on "serve.session_" / "serve.prefix_"
+    covers the scan a save or lookup runs FIRST."""
+    store = SessionStore(str(tmp_path / "s"))
+    plan = inject.FaultPlan().add("serve.session_scan", times=1)
+    with inject.inject(plan):
+        store.generations("nobody")
+    assert any(d.startswith("serve.session_scan") for d in plan.delivered)
+    pstore = PrefixStore(str(tmp_path / "p"), params_id="t", align=4)
+    plan2 = inject.FaultPlan().add("serve.prefix_scan", times=1)
+    with inject.inject(plan2):
+        pstore.generations("deadbeef")
+    assert any(d.startswith("serve.prefix_scan") for d in plan2.delivered)
+
+
+# ---------------------------------------------------------------------------
+# store units under a breaker: fail-fast with ZERO syscalls while open
+# ---------------------------------------------------------------------------
+
+
+def test_session_store_outage_opens_breaker_then_probes(tmp_path):
+    """Two failed saves open the breaker; while blocked, save/load/
+    generations refuse in O(1) with the fault plan's delivery log FROZEN
+    (the zero-syscall proof — no operation reached a fire point); after
+    the dwell the first save is the half-open probe and recovery closes
+    the breaker with the generation on disk."""
+    t = [0.0]
+    br = CircuitBreaker("session", consecutive_failures=2, backoff=1.0,
+                        jitter=0.0, clock=lambda: t[0])
+    store = SessionStore(str(tmp_path), retry=RetryPolicy(attempts=1),
+                         breaker=br)
+    sess = _fake_session()
+    assert store.save(sess) == 1  # healthy baseline
+    plan = inject.FaultPlan().degrade_site("serve.session_", kind="eio")
+    with inject.inject(plan):
+        for _ in range(2):
+            with pytest.raises(OSError):
+                store.save(sess)
+        assert br.state == "open"
+        frozen = len(plan.delivered)
+        for _ in range(5):
+            with pytest.raises(StoreUnavailableError):
+                store.save(sess)
+            with pytest.raises(StoreUnavailableError):
+                store.load("alice")
+            with pytest.raises(StoreUnavailableError):
+                store.generations("alice")
+        assert len(plan.delivered) == frozen, "open breaker must not touch disk"
+    t[0] = 1.5  # past the dwell; the regime is gone: the probe succeeds
+    gen = store.save(sess)
+    assert br.state == "closed"
+    assert store.generations("alice")[-1] == gen
+
+
+def test_prefix_store_open_breaker_is_instant_miss(tmp_path):
+    """A prefix outage degrades to cold prefill: the failed lookup walk
+    trips the breaker, further lookups are instant misses (delivery log
+    frozen), publish refuses without syscalls — and the half-open lookup
+    probe itself closes the breaker on recovery."""
+    t = [0.0]
+    br = CircuitBreaker("prefix", consecutive_failures=1, backoff=1.0,
+                        jitter=0.0, clock=lambda: t[0])
+    store = PrefixStore(str(tmp_path), params_id="t", align=4,
+                        retry=RetryPolicy(attempts=1), breaker=br)
+    prefix = np.arange(8, dtype=np.int32)[None]
+    state = {"k": np.linspace(0, 1, 12, dtype=np.float32).reshape(3, 4)}
+    assert store.publish(prefix, state) == 1
+    prompt = np.concatenate([prefix, np.array([[1, 2, 3]], np.int32)], axis=1)
+    hit = store.lookup(prompt, declared=8)
+    assert hit is not None and hit.t == 8
+    plan = inject.FaultPlan().degrade_site("serve.prefix_", kind="partition")
+    with inject.inject(plan):
+        assert store.lookup(prompt, declared=8) is None  # walk failed: miss
+        assert br.state == "open"
+        frozen = len(plan.delivered)
+        for _ in range(5):
+            assert store.lookup(prompt, declared=8) is None
+        with pytest.raises(StoreUnavailableError):
+            store.publish(prefix, state, skip_if_present=False)
+        assert len(plan.delivered) == frozen, "open breaker must not probe disk"
+    t[0] = 1.5
+    hit = store.lookup(prompt, declared=8)  # the half-open probe
+    assert hit is not None and hit.t == 8
+    assert br.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: full store outage -> zero failed requests, bitwise recovery
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sample", [GREEDY, SAMPLED], ids=["greedy", "sampled"])
+def test_store_outage_zero_failures_bitwise(mp, tmp_path, sample):
+    """THE acceptance proof: turn 1 healthy, turn 2 under a FULL outage
+    of BOTH stores (session eio + prefix partition), turn 3 after
+    recovery. Every request — session turns and shared-prefix requests —
+    completes "ok" through all three turns; mid-outage the replica is
+    DEGRADED with reason store-outage:*, /healthz and /statusz carry the
+    failure domain; after recovery the dirty backlog drains, both
+    breakers close, health returns to SERVING, and the concatenated
+    session outputs are BITWISE-equal to uninterrupted runs."""
+    model, params = mp
+    cfg = _serve_cfg(
+        tmp_path, prefill_chunk=8, prefix_dir=str(tmp_path / "prefix"),
+        params_id="storage-test:seed0", breaker_failures=1,
+        breaker_backoff=0.02, breaker_max_backoff=0.05,
+    )
+    srv = Server(model, params, cfg)
+    srv.session_store._retry = RetryPolicy(attempts=1)
+    srv.prefix_store._retry = RetryPolicy(attempts=1)
+    prompts = [_prompt(0), _prompt(1, ln=4)]
+    refs = [_ref(mp, p, 24, sample, seed=700 + i)
+            for i, p in enumerate(prompts)]
+    pref_refs = {
+        s: _ref(mp, jnp.asarray(_shared_prefix_prompt(s)), 8, sample,
+                seed=800 + s)
+        for s in (1, 2, 3)
+    }
+
+    def one_turn(turn, suffix_seed):
+        ps = [srv.submit(DecodeRequest(
+            prompt=(prompts[i] if turn == 1 else _cont()),
+            max_new_tokens=8, sample=sample, seed=700 + i,
+            session_id=f"user{i}",
+        )) for i in range(2)]
+        pp = srv.submit(DecodeRequest(
+            prompt=_shared_prefix_prompt(suffix_seed), max_new_tokens=8,
+            sample=sample, seed=800 + suffix_seed, prefix_len=24,
+        ))
+        assert srv.serve(drain_when_idle=True) == 0
+        return ps, pp
+
+    # -- turn 1: healthy; saves land, the shared prefix publishes --
+    t1, a = one_turn(1, 1)
+    for i, p in enumerate(t1):
+        assert p.result is not None and p.result.status == "ok", p.error
+        np.testing.assert_array_equal(p.result.tokens, refs[i][:, :8])
+    assert a.result.status == "ok"
+    np.testing.assert_array_equal(a.result.tokens, pref_refs[1])
+    assert srv.session_store.newest_generation("user0") >= 1
+
+    # -- turn 2: FULL outage of both stores --
+    plan = inject.FaultPlan()
+    plan.degrade_site("serve.session_", kind="eio")
+    plan.degrade_site("serve.prefix_", kind="partition")
+    with inject.inject(plan):
+        t2, b = one_turn(2, 2)
+        # mid-outage: everything still served (resident affinity + cold
+        # prefill), the turns are write-behind DIRTY, the replica says
+        # exactly which failure domain is down
+        for p in t2:
+            assert p.result is not None and p.result.status == "ok", p.error
+        assert b.result.status == "ok"
+        np.testing.assert_array_equal(b.result.tokens, pref_refs[2])
+        assert srv._dirty_sessions == {"user0", "user1"}
+        assert srv.health.state is Health.DEGRADED
+        assert srv.health.reason.startswith("store-outage:")
+        assert srv._healthz()["status"].startswith("degraded: store-outage:")
+        fd = srv._statusz()["failure_domains"]
+        assert fd["breakers"]["session"]["state"] in ("open", "half_open")
+        assert fd["dirty_backlog"] == 2
+
+    # -- turn 3: store is back; probes close the breakers, backlog drains --
+    t3, c = one_turn(3, 3)
+    for p in t3:
+        assert p.result is not None and p.result.status == "ok", p.error
+    assert c.result.status == "ok"
+    np.testing.assert_array_equal(c.result.tokens, pref_refs[3])
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and (
+        srv._dirty_sessions
+        or any(br.state != "closed" for br in srv._breakers.values())
+        or srv.health.state is not Health.SERVING
+    ):
+        time.sleep(0.03)
+        assert srv.serve(drain_when_idle=True) == 0
+    assert not srv._dirty_sessions, "dirty backlog must drain after recovery"
+    assert {n: br.state for n, br in srv._breakers.items()} == {
+        "session": "closed", "prefix": "closed",
+    }
+    assert srv.health.state is Health.SERVING
+    # zero lost turns, bitwise: three 8-token turns == one 24-token run
+    for i in range(2):
+        total = np.concatenate(
+            [t1[i].result.tokens, t2[i].result.tokens, t3[i].result.tokens],
+            axis=1,
+        )
+        np.testing.assert_array_equal(total, refs[i], err_msg=f"session {i}")
+    # the outage never surfaced as a failure: nothing failed, nothing shed
+    flat = srv.metrics.counters_flat()
+    assert flat.get("failed", 0) == 0 and flat.get("shed", 0) == 0
+    # the turns served during the outage are on disk now
+    assert srv.session_store.generations("user0"), "recovered saves committed"
+    srv.close()
+
+
+def test_breakers_recover_without_traffic(mp, tmp_path):
+    """An open breaker with NO natural probe traffic still recovers:
+    the session breaker's probe normally rides the dirty-retry sweep
+    and the prefix breaker's rides lookups/queued publishes, but a
+    breaker that tripped while idle (a read blip, nothing dirty,
+    nothing queued) has no probe driver — the chunk-boundary health
+    tick runs one half-open directory scan per dwell, so the replica
+    closes both breakers and returns to SERVING instead of sitting
+    DEGRADED until the next request happens to arrive."""
+    model, params = mp
+    cfg = _serve_cfg(
+        tmp_path, prefix_dir=str(tmp_path / "prefix"),
+        params_id="idle-probe", breaker_failures=1,
+        breaker_backoff=0.02, breaker_max_backoff=0.05,
+    )
+    srv = Server(model, params, cfg)
+    srv.prefix_store.breaker.record_failure("induced outage")
+    srv.session_store.breaker.record_failure("induced outage")
+    assert srv.serve(drain_when_idle=True) == 0  # latches DEGRADED
+    assert srv.health.state is Health.DEGRADED
+    assert srv.health.reason.startswith("store-outage:")
+    # zero submits from here on: recovery evidence must be self-driven
+    deadline = time.monotonic() + 5.0
+    while (time.monotonic() < deadline
+           and any(b.state != "closed" for b in srv._breakers.values())):
+        time.sleep(0.01)
+        assert srv.serve(drain_when_idle=True) == 0
+    assert all(b.state == "closed" for b in srv._breakers.values())
+    assert srv.health.state is Health.SERVING
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# fail-fast: an open breaker costs O(1) host work per would-be store touch
+# ---------------------------------------------------------------------------
+
+
+def test_open_breaker_fail_fast_zero_store_syscalls(mp, tmp_path):
+    """With the session breaker open and a 2s-per-operation latency
+    brownout armed UNDER it, a resident session's turn completes without
+    the stall ever running: the fault plan's delivery log stays empty
+    because no store syscall reaches a fire point — the breaker refused
+    each touch in O(1) before the filesystem."""
+    model, params = mp
+    srv = Server(model, params, _serve_cfg(
+        tmp_path, breaker_failures=1, breaker_backoff=30.0,
+        breaker_max_backoff=30.0,
+    ))
+    srv.session_store._retry = RetryPolicy(attempts=1)
+    p1 = _run_turn(srv, _prompt(40), 8, GREEDY, 40, "res")
+    assert p1.result.status == "ok"
+    srv.session_store.breaker.record_failure("induced outage")
+    assert srv.session_store.breaker.state == "open"
+    plan = inject.FaultPlan().degrade_site(
+        "serve.session_", kind="latency", latency=2.0,
+    )
+    t0 = time.monotonic()
+    with inject.inject(plan):
+        p2 = _run_turn(srv, _cont(), 8, GREEDY, 0, "res")
+    elapsed = time.monotonic() - t0
+    assert p2.result is not None and p2.result.status == "ok", p2.error
+    assert plan.delivered == [], "open breaker: no syscall may reach a site"
+    # without the breaker the staleness probe + the save would each stall
+    # 2s; with it the whole turn is decode-bound
+    assert elapsed < 3.5, f"turn took {elapsed:.2f}s under an open breaker"
+    assert "res" in srv._dirty_sessions  # refused save -> write-behind pin
+    assert srv.health.state is Health.DEGRADED
+    assert srv.health.reason == "store-outage:session"
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# bounded write-behind: the dirty cap sheds retriable, never fails
+# ---------------------------------------------------------------------------
+
+
+def test_dirty_cap_sheds_new_sessions_retriable(mp, tmp_path):
+    """At max_dirty_sessions, a NEW session admission is refused with a
+    retriable OverloadError (flight event session_shed) while sessions
+    ALREADY dirty keep serving — their risk exists either way and
+    affinity keeps their turns in order."""
+    model, params = mp
+    srv = Server(model, params, _serve_cfg(
+        tmp_path, max_dirty_sessions=1, breaker_failures=1,
+        breaker_backoff=30.0, breaker_max_backoff=30.0,
+    ))
+    srv.session_store._retry = RetryPolicy(attempts=1)
+    pa = _run_turn(srv, _prompt(50), 8, GREEDY, 50, "a")
+    assert pa.result.status == "ok"
+    plan = inject.FaultPlan().degrade_site("serve.session_", kind="eio")
+    with inject.inject(plan):
+        pa2 = _run_turn(srv, _cont(), 8, GREEDY, 0, "a")
+    assert pa2.result is not None and pa2.result.status == "ok", pa2.error
+    assert srv._dirty_sessions == {"a"}  # the cap is now full
+    # a NEW conversation would grow the at-risk set: shed retriable
+    pc = _run_turn(srv, _prompt(51), 8, GREEDY, 51, "c")
+    assert pc.result is None
+    assert isinstance(pc.error, OverloadError)
+    assert "retry" in str(pc.error)
+    assert srv.flight.events("session_shed")
+    assert srv.metrics.counters_flat().get("shed", 0) == 1
+    # the already-dirty session still serves (still refused saves: the
+    # breaker is open with a 30s dwell, so it stays dirty)
+    pa3 = _run_turn(srv, _cont(), 8, GREEDY, 0, "a")
+    assert pa3.result is not None and pa3.result.status == "ok", pa3.error
+    assert srv._dirty_sessions == {"a"}
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM mid-outage: hold the drain, report the dirty loudly, exit 0
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_mid_outage_reports_dirty_and_exits_zero(mp, tmp_path):
+    """A drain that collides with a never-ending store outage holds the
+    dirty sessions through the grace window (retrying via half-open
+    probes), then exits 0 with the unsaved sessions named in a warning
+    and a drain_dirty flight event — turns at risk are REPORTED, never
+    silently dropped, and the drain itself still succeeds."""
+    model, params = mp
+    srv = Server(model, params, _serve_cfg(
+        tmp_path, grace=0.5, poll=0.01, breaker_failures=1,
+        breaker_backoff=0.05, breaker_max_backoff=0.1,
+    ))
+    srv.session_store._retry = RetryPolicy(attempts=1)
+    p1 = _run_turn(srv, _prompt(60), 8, GREEDY, 60, "u")
+    assert p1.result.status == "ok"
+    plan = inject.FaultPlan().degrade_site("serve.session_", kind="eio")
+    # SIGTERM at the next engine chunk boundary: the turn suspends after
+    # its first chunk, mid-stream
+    plan.preempt_at_chunk(srv.engine._chunk_counter)
+    p2 = srv.submit(DecodeRequest(
+        prompt=_cont(), max_new_tokens=8, sample=GREEDY, seed=0,
+        session_id="u",
+    ))
+    with pytest.warns(UserWarning, match="dirty session"):
+        with inject.inject(plan):
+            rc = srv.serve()
+    assert rc == 0 and srv.health.state is Health.DEAD
+    assert p2.result is not None and p2.result.status == "suspended"
+    assert 0 < p2.result.new_tokens < 8, "must suspend MID-stream"
+    events = srv.flight.events("drain_dirty")
+    assert events and events[-1]["count"] == 1
+    # the turn the outage swallowed was reported, not persisted: disk
+    # still holds only turn 1's generation
+    assert SessionStore(str(tmp_path / "sessions")).generations("u") == [1]
