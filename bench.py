@@ -2458,27 +2458,35 @@ _CONCURRENCY_PREFLIGHT_DONE = False
 
 def _concurrency_preflight() -> None:
     """Refuse to write a BENCH_SERVE row from a tree with active Tier D
-    findings: a serving number measured on a lock-discipline regression
-    (a wire round-trip under the router lock, an unguarded stats write)
-    is a number about a different — and racy — program. Runs the audit
-    in a subprocess (`--tier concurrency` is a sub-second pure-AST pass)
-    once per bench invocation; the JSON output is surfaced on failure so
-    the offending rule/file/line is in the bench log itself."""
+    or Tier E findings: a serving number measured on a lock-discipline
+    regression is a number about a different — and racy — program, and
+    one measured on an unregistered jit or a drifted decode plan carries
+    compile stalls the planned replica would never pay. Runs each audit
+    in a subprocess once per bench invocation (Tier D is a sub-second
+    pure-AST pass; Tier E adds one memoized lowering of the canonical
+    footprint, pinned <45s and forced onto the CPU backend so the
+    preflight never waits on the chips the bench is about to use); the
+    JSON output is surfaced on failure so the offending rule/file/line
+    is in the bench log itself."""
     global _CONCURRENCY_PREFLIGHT_DONE
     if _CONCURRENCY_PREFLIGHT_DONE:
         return
-    proc = subprocess.run(
-        [sys.executable, "-m", "orion_tpu.analysis",
-         "--tier", "concurrency", "--format", "json"],
-        capture_output=True, text=True,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-    )
-    if proc.returncode != 0:
-        raise RuntimeError(
-            "concurrency audit preflight failed — fix the findings (or "
-            "baseline them with a rationale) before committing serving "
-            "numbers:\n" + (proc.stdout or proc.stderr)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    for tier, label in (("concurrency", "concurrency (Tier D)"),
+                        ("programs", "program (Tier E)")):
+        proc = subprocess.run(
+            [sys.executable, "-m", "orion_tpu.analysis",
+             "--tier", tier, "--format", "json"],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
         )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{label} audit preflight failed — fix the findings (or "
+                "baseline them with a rationale) before committing "
+                "serving numbers:\n" + (proc.stdout or proc.stderr)
+            )
     _CONCURRENCY_PREFLIGHT_DONE = True
 
 

@@ -1,6 +1,6 @@
 """Static analysis for orion-tpu: AST lint + jaxpr contracts + SPMD audits.
 
-Three tiers, one CLI (``python -m orion_tpu.analysis``), all part of tier-1
+Five tiers, one CLI (``python -m orion_tpu.analysis``), all part of tier-1
 via tests/test_analysis.py:
 
 - **Tier A** (analysis/lint.py, analysis/rules/): AST lint over the package —
@@ -19,11 +19,24 @@ via tests/test_analysis.py:
   to HLO and diffs op histogram / collectives / scan-carry bytes / cost
   model / donation aliasing against golden snapshots (analysis/golden/,
   regenerated via ``--update-golden``).
+- **Tier D** (analysis/concurrency_audit.py): pure-AST lock-discipline audit
+  of the threaded serving stack against the declared hierarchy in
+  serving/locks.py — acquisition order, held-lock bans, guarded-state
+  writes, undeclared locks, scope creep.
+- **Tier E** (analysis/program_audit.py): the compile universe is closed —
+  every jit/shard_map in generate.py, serving/, parallel/ is declared in
+  analysis/programs.py with a finite static key space; aot.decode_plan's
+  inventory, the DECODE_PROGRAMS registry, and the declared donation all
+  stay in sync (pure AST plus one memoized lowering, never executes).
 
 Suppression: ``# orion: noqa[rule-id]`` on (any physical line of) the
 finding's logical line; grandfathered findings live in analysis/baseline.json
 with a mandatory rationale. ``--format json`` emits machine-readable
-findings with suppressed/baselined status for CI.
+findings with suppressed/baselined status plus a per-tier ``"tiers"``
+summary for CI. A post-run staleness pass (analysis/staleness.py) flags
+suppressions that no longer suppress anything (stale-noqa,
+dead-baseline-entry; ``--prune-baseline`` rewrites the baseline minus the
+dead entries).
 """
 
 from orion_tpu.analysis.findings import (  # noqa: F401

@@ -6,13 +6,20 @@ Tiers: A = AST lint, B = jaxpr contracts, C = SPMD collective budgets
 (``--tier spmd``) + golden compile-artifact snapshots (``--tier golden``),
 D = concurrency audit over the threaded serving stack
 (``--tier concurrency``: declared lock hierarchy, held-lock discipline,
-guarded-state — serving/locks.py is the declaration).
+guarded-state — serving/locks.py is the declaration), E = closed
+compile-universe audit (``--tier programs``: every jit registered in
+analysis/programs.py, static key spaces finite, aot.decode_plan in sync,
+donation pinned — pure AST plus memoized lowering, never executes).
+After the tiers, a staleness pass judges the suppressions themselves:
+a noqa that mutes nothing or a baseline entry matching no finding is a
+finding (``--prune-baseline`` rewrites the baseline minus dead entries).
 ``--update-golden`` regenerates the snapshots under analysis/golden/ for
 PRs that intentionally change the compiled program. ``--format json``
 emits machine-readable findings (suppressed/baselined included, with
-status) for CI and bots. ``--self-time`` prints per-tier wall time to
-stderr — the suite lives inside the 870s tier-1 gate and must be kept
-honest about where the seconds go."""
+status) plus a per-tier summary trailer (``"tiers"``) so CI logs show
+which tier gated. ``--self-time`` prints per-tier wall time to stderr —
+the suite lives inside the 870s tier-1 gate and must be kept honest
+about where the seconds go."""
 
 from __future__ import annotations
 
@@ -21,14 +28,38 @@ import json
 import os
 import sys
 import time
-from typing import List
+from typing import Dict, List
+
+TIER_LABELS = {
+    "lint": "tier A", "jaxpr": "tier B", "spmd": "tier C/spmd",
+    "golden": "tier C/golden", "concurrency": "tier D",
+    "programs": "tier E", "suppressions": "staleness",
+    "all": "tiers A+B+C+D+E",
+}
+
+
+def tier_summary_lines(rows: List[Dict]) -> List[str]:
+    """The ``--tier all`` per-tier summary table (text mode). ``rows``
+    are the same dicts the json ``"tiers"`` trailer carries."""
+    header = (
+        f"{'tier':<22} {'active':>6} {'suppr':>6} {'basel':>6} "
+        f"{'seconds':>8}"
+    )
+    out = [header, "-" * len(header)]
+    for r in rows:
+        out.append(
+            f"{r['label']:<22} {r['active']:>6} {r['suppressed']:>6} "
+            f"{r['baselined']:>6} {r['seconds']:>8.2f}"
+        )
+    return out
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "orion_tpu.analysis",
         description="orion-tpu static analysis: AST lint + jaxpr contracts "
-        "+ SPMD collective budgets + golden compile snapshots",
+        "+ SPMD collective budgets + golden compile snapshots + "
+        "concurrency audit + compile-universe audit",
     )
     p.add_argument(
         "paths", nargs="*",
@@ -36,7 +67,10 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--tier",
-        choices=["lint", "jaxpr", "spmd", "golden", "concurrency", "all"],
+        choices=[
+            "lint", "jaxpr", "spmd", "golden", "concurrency", "programs",
+            "all",
+        ],
         default="all",
         help="lint = Tier A AST rules; jaxpr = Tier B contract audit "
         "(traces the train/LRA/decode steps on abstract shapes); spmd = "
@@ -44,7 +78,10 @@ def main(argv=None) -> int:
         "an abstract 8-device mesh); golden = Tier C compile-artifact "
         "snapshot diff; concurrency = Tier D lock-discipline audit of "
         "the threaded serving stack (pure AST — never imports or "
-        "executes the audited code, zero traces/compiles/device work)",
+        "executes the audited code); programs = Tier E compile-universe "
+        "audit (every jit declared in analysis/programs.py, static key "
+        "spaces finite, decode_plan/donation in sync — AST plus "
+        "memoized lowering, never executes)",
     )
     p.add_argument(
         "--baseline", default=None,
@@ -54,7 +91,8 @@ def main(argv=None) -> int:
     p.add_argument(
         "--format", choices=["text", "json"], default="text",
         help="json: one object per finding (rule, path, line, message, "
-        "status incl. suppressed/baselined) — for CI consumption",
+        "status incl. suppressed/baselined) plus a per-tier 'tiers' "
+        "summary trailer — for CI consumption",
     )
     p.add_argument(
         "--update-golden", action="store_true",
@@ -73,6 +111,12 @@ def main(argv=None) -> int:
         help="print per-tier wall time to stderr (runtime-budget "
         "accounting for the tier-1 gate)",
     )
+    p.add_argument(
+        "--prune-baseline", action="store_true",
+        help="rewrite the baseline file minus entries that no longer "
+        "match any finding (rationales of live entries are preserved), "
+        "then continue the run without the pruned dead-entry findings",
+    )
     args = p.parse_args(argv)
 
     # Tier C traces/compiles against the abstract 8-virtual-CPU-device
@@ -83,19 +127,19 @@ def main(argv=None) -> int:
 
         ensure_cpu_devices()
 
-    from orion_tpu.analysis import concurrency_audit
+    from orion_tpu.analysis import concurrency_audit, program_audit
+    from orion_tpu.analysis import staleness as stale
     from orion_tpu.analysis.findings import (
         DEFAULT_BASELINE,
         Finding,
-        annotate_baseline,
-        apply_baseline,
         load_baseline,
     )
     from orion_tpu.analysis.lint import lint_paths
     from orion_tpu.analysis.rules import ALL_RULES
 
-    # B/C modules trace and compile at audit time; a pure Tier D (or A)
-    # run must stay AST-only — zero traces, zero compiles, zero syncs
+    # B/C modules trace and compile at audit time; a pure Tier A/D/E run
+    # must stay import-light — Tier E itself only touches jax inside the
+    # memoized lowering pass
     need_jax_tiers = (
         args.update_golden or args.list_rules
         or args.tier in ("jaxpr", "spmd", "golden", "all")
@@ -116,6 +160,17 @@ def main(argv=None) -> int:
         print("Tier D (concurrency audit, serving/locks.py declaration):")
         for rule in concurrency_audit.concurrency_rules():
             print(f"  {rule.id:<20} {rule.title}")
+        print("Tier E (compile universe, analysis/programs.py "
+              "declaration):")
+        for rule in program_audit.program_rules():
+            print(f"  {rule.id:<20} {rule.title}")
+        print(f"  {program_audit.RULE_PLAN:<20} "
+              "decode_plan inventory vs declared universe")
+        print(f"  {program_audit.RULE_DONATION:<20} "
+              "donate_argnums vs declaration vs golden snapshots")
+        print("Staleness (suppressions must decay):")
+        for cid in stale.ALL_STALENESS_CHECKS:
+            print(f"  {cid}")
         return 0
 
     golden_dir = args.golden_dir or (
@@ -143,69 +198,139 @@ def main(argv=None) -> int:
     )
     paths = args.paths or [os.path.join(repo_root, "orion_tpu")]
 
+    baseline_path = None
     if args.baseline == "none":
         baseline = []
     else:
-        baseline = load_baseline(args.baseline or DEFAULT_BASELINE)
+        baseline_path = args.baseline or DEFAULT_BASELINE
+        baseline = load_baseline(baseline_path)
 
-    keep = args.format == "json"
+    # every tier runs keep-suppressed internally so the staleness pass
+    # can see which suppressions are alive; text mode filters at the end
+    tier_rows: List[Dict] = []
+    findings: List[Finding] = []
+
+    def run_tier(tier: str, fn) -> None:
+        t0 = time.perf_counter()
+        fs = fn()
+        tier_rows.append({
+            "tier": tier,
+            "label": TIER_LABELS[tier],
+            "active": sum(1 for f in fs if f.status == "active"),
+            "suppressed": sum(1 for f in fs if f.status == "suppressed"),
+            "baselined": sum(1 for f in fs if f.status == "baselined"),
+            "seconds": time.perf_counter() - t0,
+        })
+        findings.extend(fs)
 
     def finish(fs: List[Finding]) -> List[Finding]:
-        """Baseline the non-lint tiers (lint_paths baselines internally)."""
-        return (
-            annotate_baseline(fs, baseline)
-            if keep
-            else apply_baseline(fs, baseline)
-        )
+        """Baseline the tiers that don't do it internally (B/C)."""
+        from orion_tpu.analysis.findings import annotate_baseline
 
-    self_times: List = []
+        return annotate_baseline(fs, baseline)
 
-    def timed(label: str, fn):
-        t0 = time.perf_counter()
-        out = fn()
-        self_times.append((label, time.perf_counter() - t0))
-        return out
-
-    findings: List[Finding] = []
     if args.tier in ("lint", "all"):
-        findings += timed("tier A (lint)", lambda: lint_paths(
-            paths, baseline=baseline, root=repo_root, keep_suppressed=keep
+        run_tier("lint", lambda: lint_paths(
+            paths, baseline=baseline, root=repo_root, keep_suppressed=True
         ))
     if args.tier in ("jaxpr", "all"):
-        findings += timed(
-            "tier B (jaxpr)", lambda: finish(jaxpr_audit.audit_repo())
-        )
+        run_tier("jaxpr", lambda: finish(jaxpr_audit.audit_repo()))
     if args.tier in ("spmd", "all"):
-        findings += timed(
-            "tier C (spmd)", lambda: finish(spmd_audit.audit_spmd())
-        )
+        run_tier("spmd", lambda: finish(spmd_audit.audit_spmd()))
     if args.tier in ("golden", "all"):
-        findings += timed("tier C (golden)", lambda: finish(
+        run_tier("golden", lambda: finish(
             snapshots.audit_golden(golden_dir=golden_dir)
         ))
     if args.tier in ("concurrency", "all"):
-        findings += timed(
-            "tier D (concurrency)",
+        run_tier(
+            "concurrency",
             lambda: concurrency_audit.audit_concurrency(
-                root=repo_root, baseline=baseline, keep_suppressed=keep
+                root=repo_root, baseline=baseline, keep_suppressed=True
+            ),
+        )
+    if args.tier in ("programs", "all"):
+        run_tier(
+            "programs",
+            lambda: program_audit.audit_programs(
+                root=repo_root, baseline=baseline, keep_suppressed=True
             ),
         )
 
+    # -- staleness pass: judge the suppressions against what just ran ----
+    ran_ids: List[str] = []
+    stale_paths: List[str] = []
+    audited_rel: List[str] = []
+    ran_tiers = {r["tier"] for r in tier_rows}
+    if "lint" in ran_tiers:
+        ran_ids += list(ALL_RULES.keys())
+        stale_paths += list(paths)
+        from orion_tpu.analysis.findings import normalize_path
+
+        audited_rel += [normalize_path(p, repo_root) for p in paths]
+    if "concurrency" in ran_tiers:
+        ran_ids += [r.id for r in concurrency_audit.concurrency_rules()]
+        stale_paths += [
+            os.path.join(repo_root, p)
+            for p in concurrency_audit.TIER_D_PACKAGES
+        ]
+        audited_rel += list(concurrency_audit.TIER_D_PACKAGES)
+    if "programs" in ran_tiers:
+        ran_ids += list(program_audit.ALL_PROGRAM_CHECKS)
+        stale_paths += [
+            os.path.join(repo_root, p) for p in program_audit.TIER_E_PATHS
+        ]
+        audited_rel += list(program_audit.TIER_E_PATHS)
+    # B/C contract findings live on synthetic "<target>" paths, not
+    # noqa-suppressable source lines — their ids stay out of the judging
+    # set so a partial run never calls their baselines dead
+    if ran_ids:
+        full = args.tier == "all" and not args.paths
+        t0 = time.perf_counter()
+        seen = set()
+        uniq = [
+            q for q in stale_paths
+            if not (q in seen or seen.add(q))
+        ]
+        stale_fs = stale.stale_noqa_findings(
+            findings, uniq, ran_ids, root=repo_root, full=full
+        )
+        dead = stale.dead_baseline_entries(
+            findings, baseline, ran_ids, audited_rel
+        )
+        if dead and args.prune_baseline and baseline_path:
+            removed = stale.prune_baseline(baseline_path, dead)
+            print(
+                f"pruned {removed} dead baseline entr"
+                f"{'y' if removed == 1 else 'ies'} from {baseline_path}",
+                file=sys.stderr,
+            )
+            dead = []
+        stale_fs += stale.dead_baseline_findings(
+            dead, baseline_path or DEFAULT_BASELINE, repo_root
+        )
+        if stale_fs:
+            tier_rows.append({
+                "tier": "suppressions",
+                "label": TIER_LABELS["suppressions"],
+                "active": len(stale_fs),
+                "suppressed": 0, "baselined": 0,
+                "seconds": time.perf_counter() - t0,
+            })
+            findings.extend(stale_fs)
+
     if args.self_time:
-        for label, dt in self_times:
-            print(f"self-time: {label:<22} {dt:8.2f}s", file=sys.stderr)
+        for r in tier_rows:
+            print(
+                f"self-time: {r['label']:<22} {r['seconds']:8.2f}s",
+                file=sys.stderr,
+            )
         print(
             f"self-time: {'total':<22} "
-            f"{sum(dt for _, dt in self_times):8.2f}s",
+            f"{sum(r['seconds'] for r in tier_rows):8.2f}s",
             file=sys.stderr,
         )
 
     active = [f for f in findings if f.status == "active"]
-    tiers = {
-        "lint": "tier A", "jaxpr": "tier B", "spmd": "tier C/spmd",
-        "golden": "tier C/golden", "concurrency": "tier D",
-        "all": "tiers A+B+C+D",
-    }
     if args.format == "json":
         doc = {
             "tier": args.tier,
@@ -219,24 +344,29 @@ def main(argv=None) -> int:
                     1 for f in findings if f.status == "baselined"
                 ),
             },
+            "tiers": tier_rows,
         }
         print(json.dumps(doc, indent=2))
         return 1 if active else 0
 
     for f in active:
         print(f.format())
+    if args.tier == "all":
+        for line in tier_summary_lines(tier_rows):
+            print(line, file=sys.stderr)
     n = len(active)
     if n:
         print(
-            f"\n{n} finding(s) ({tiers[args.tier]}). Fix them, suppress a "
-            "false positive in-line with `# orion: noqa[rule-id]`, baseline "
-            "it with a reason in orion_tpu/analysis/baseline.json, or — for "
-            "an intentional compiled-program change — rerun with "
-            "--update-golden and commit the new snapshot.",
+            f"\n{n} finding(s) ({TIER_LABELS[args.tier]}). Fix them, "
+            "suppress a false positive in-line with a targeted noqa "
+            "comment, baseline it with a reason in "
+            "orion_tpu/analysis/baseline.json, or — for an intentional "
+            "compiled-program change — rerun with --update-golden and "
+            "commit the new snapshot.",
             file=sys.stderr,
         )
         return 1
-    print(f"analysis clean ({tiers[args.tier]})")
+    print(f"analysis clean ({TIER_LABELS[args.tier]})")
     return 0
 
 

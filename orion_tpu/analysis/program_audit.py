@@ -1,0 +1,929 @@
+"""Tier E: closed compile-universe audit (ISSUE 18).
+
+``python -m orion_tpu.analysis --tier programs`` — a pure-AST +
+lowering-only (never-execute) auditor of the jit program universe against
+the declaration in ``analysis/programs.py``, the way Tier D audits the
+threaded stack against ``serving/locks.py``. ROADMAP item 1's executable
+store assumes the universe is closed: every entrypoint registered, every
+static key space finite, the AOT plan exactly what a replica compiles.
+Tier E turns each of those assumptions into a findings-producing rule:
+
+- **unregistered-jit** — a ``jax.jit``/``pjit``/``shard_map`` site in
+  ``generate.py``/``serving/``/``parallel/`` with no
+  :class:`~orion_tpu.analysis.programs.ProgramDecl` row. A new jit is a
+  new executable the fleet must plan for; declaring it is the act of
+  planning.
+- **unbounded-static-key** — a static parameter of a registered program
+  (decl ``keyspace="closed"``) whose value, traced interprocedurally
+  through same-module call sites, derives from request/runtime data
+  rather than a declared finite domain (``programs.FINITE_DOMAINS`` /
+  config-attribute reads / literals). Also fires when the AST static
+  signature drifts from the declared ``static_args``.
+- **recompile-hazard** — silent cache-blowup shapes: a jitted function
+  closing over a module/enclosing-scope array, dict/set iteration or a
+  float literal feeding a static argument, ``functools.partial``
+  re-wrapping a registered wrapper inside a function body.
+- **plan-drift** — ``generate.DECODE_PROGRAMS`` diffed against the
+  declared decode section, and ``aot.decode_plan``'s inventory diffed
+  against :func:`programs.expected_decode_universe` per declared check
+  footprint; the canonical footprint is additionally LOWERED (memoized
+  process-wide) so a planned program that no longer lowers is a finding,
+  not a cold-replica surprise.
+- **donation-drift** — ``donate_argnums`` on registered wrappers checked
+  three-way: AST vs declaration vs the golden snapshots' recorded
+  donation counts.
+
+Findings ride the standard pipeline: ``# orion: noqa[rule-id]``,
+baseline.json with rationale, ``--format json`` statuses. Like Tier D,
+the rules deliberately do NOT register in ``rules/__init__.ALL_RULES``:
+they run only over the Tier E packages and carry their own fixture
+contract in ``tests/test_program_audit.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from orion_tpu.analysis import programs as _decls
+from orion_tpu.analysis.findings import (
+    BaselineEntry,
+    Finding,
+    annotate_baseline,
+    apply_baseline,
+    normalize_path,
+)
+from orion_tpu.analysis.lint import (
+    ModuleContext,
+    _is_jit_expr,
+    dotted_name,
+    jit_decorations,
+    lint_paths,
+)
+
+RULE_UNREGISTERED = "unregistered-jit"
+RULE_UNBOUNDED = "unbounded-static-key"
+RULE_HAZARD = "recompile-hazard"
+RULE_PLAN = "plan-drift"
+RULE_DONATION = "donation-drift"
+
+ALL_PROGRAM_CHECKS = (
+    RULE_UNREGISTERED, RULE_UNBOUNDED, RULE_HAZARD, RULE_PLAN,
+    RULE_DONATION,
+)
+
+# Tier E scope (ISSUE 18): everything that creates device programs
+TIER_E_PATHS = (
+    "orion_tpu/generate.py", "orion_tpu/serving", "orion_tpu/parallel",
+)
+
+_SHARD_NAMES = frozenset({"shard_map", "jax.shard_map"})
+
+_FINITE_BUILTINS = frozenset({
+    "int", "bool", "str", "len", "min", "max", "abs", "round", "tuple",
+    "sorted",
+})
+
+_ARRAY_ROOTS = ("jnp.", "np.", "numpy.", "jax.numpy.", "jax.random.")
+
+
+class ProgramTable:
+    """The declaration, indexed for the rules (injectable in tests)."""
+
+    def __init__(self, decls, finite_domains=None, finite_attr_bases=None):
+        self.decls: Tuple[Any, ...] = tuple(decls)
+        self.by_site: Dict[Tuple[str, str], Any] = {
+            (d.module, d.qualname): d for d in self.decls
+        }
+        self.by_name: Dict[str, Any] = {d.name: d for d in self.decls}
+        self.finite_domains: Dict[str, str] = dict(
+            _decls.FINITE_DOMAINS if finite_domains is None
+            else finite_domains
+        )
+        self.finite_attr_bases = frozenset(
+            _decls.FINITE_ATTR_BASES if finite_attr_bases is None
+            else finite_attr_bases
+        )
+        self.qualnames = frozenset(d.qualname for d in self.decls)
+
+    def decl_at(self, path: str, qualname: str):
+        return self.by_site.get((path, qualname))
+
+    def section(self, name: str):
+        return [d for d in self.decls if d.section == name]
+
+
+_TABLE: Optional[ProgramTable] = None
+
+
+def load_program_table() -> ProgramTable:
+    global _TABLE
+    if _TABLE is None:
+        _TABLE = ProgramTable(_decls.PROGRAMS)
+    return _TABLE
+
+
+# -- the per-module model ------------------------------------------------------
+
+
+class _FnScope:
+    __slots__ = ("node", "params")
+
+    def __init__(self, node: ast.AST):
+        self.node = node
+        a = node.args
+        self.params = [p.arg for p in a.posonlyargs + a.args]
+
+
+class ProgramModel:
+    """Jit sites, call sites, and value classification for one module."""
+
+    def __init__(self, ctx: ModuleContext, table: ProgramTable):
+        self.ctx = ctx
+        self.table = table
+        tree = ctx.tree
+        self.defs = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        self.fns_by_name: Dict[str, List[_FnScope]] = {}
+        for fn in self.defs:
+            self.fns_by_name.setdefault(fn.name, []).append(_FnScope(fn))
+        dec_nodes = set()
+        for fn in self.defs:
+            for d in fn.decorator_list:
+                for sub in ast.walk(d):
+                    dec_nodes.add(id(sub))
+        # decorated jit wrappers: (def node, the jit decorator expr)
+        self.jit_defs: List[Tuple[ast.AST, ast.expr]] = [
+            (fn, jit_decorations(fn)[0])
+            for fn in self.defs
+            if jit_decorations(fn)
+        ]
+        # bare jit/shard_map creation sites outside decorator expressions
+        self.bare_sites: List[Tuple[ast.Call, str]] = []
+        for call in ast.walk(tree):
+            if not isinstance(call, ast.Call) or id(call) in dec_nodes:
+                continue
+            fname = dotted_name(call.func)
+            if _is_jit_expr(call.func) or fname in _SHARD_NAMES:
+                self.bare_sites.append((call, self._site_qualname(call)))
+        # call sites by callee name (plain Name calls only)
+        self.calls_by_name: Dict[str, List[ast.Call]] = {}
+        for call in ast.walk(tree):
+            if isinstance(call, ast.Call) and isinstance(
+                call.func, ast.Name
+            ):
+                self.calls_by_name.setdefault(
+                    call.func.id, []
+                ).append(call)
+        # module-level assignments: name -> RHS expr
+        self.module_assigns: Dict[str, ast.expr] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                self.module_assigns[node.targets[0].id] = node.value
+
+    # -- structure helpers ----------------------------------------------------
+
+    def enclosing_fn(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = getattr(node, "_orion_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = getattr(cur, "_orion_parent", None)
+        return None
+
+    def _site_qualname(self, call: ast.Call) -> str:
+        fn = self.enclosing_fn(call)
+        if fn is not None:
+            return fn.name
+        # module-level site: use the assignment target when there is one
+        cur = getattr(call, "_orion_parent", None)
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = getattr(cur, "_orion_parent", None)
+        if isinstance(cur, ast.Assign) and len(cur.targets) == 1 and \
+                isinstance(cur.targets[0], ast.Name):
+            return cur.targets[0].id
+        return "<module>"
+
+    def static_params(
+        self, fn: ast.AST, dec: ast.expr
+    ) -> List[Tuple[Optional[int], str]]:
+        """(position, param name) for each static argument the decorator
+        declares, in declaration order. Unresolvable specs are skipped —
+        the signature-drift check surfaces them via name mismatch."""
+        kws: Dict[str, ast.expr] = {}
+        if isinstance(dec, ast.Call):
+            kws = {k.arg: k.value for k in dec.keywords if k.arg}
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        out: List[Tuple[Optional[int], str]] = []
+        nums = kws.get("static_argnums")
+        if nums is not None:
+            elts = nums.elts if isinstance(
+                nums, (ast.Tuple, ast.List)
+            ) else [nums]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(
+                    e.value, int
+                ) and 0 <= e.value < len(params):
+                    out.append((e.value, params[e.value]))
+        names = kws.get("static_argnames")
+        if names is not None:
+            elts = names.elts if isinstance(
+                names, (ast.Tuple, ast.List)
+            ) else [names]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(
+                    e.value, str
+                ):
+                    pos = (
+                        params.index(e.value) if e.value in params else None
+                    )
+                    out.append((pos, e.value))
+        return out
+
+    def call_arg(
+        self, call: ast.Call, pos: Optional[int], pname: str
+    ) -> Optional[ast.expr]:
+        for kw in call.keywords:
+            if kw.arg == pname:
+                return kw.value
+        if pos is not None and pos < len(call.args):
+            arg = call.args[pos]
+            if isinstance(arg, ast.Starred):
+                return None
+            return arg
+        return None
+
+    # -- finiteness classification --------------------------------------------
+
+    def classify(
+        self,
+        expr: ast.expr,
+        encl: Optional[ast.AST],
+        depth: int = 0,
+        seen: Optional[set] = None,
+    ) -> Optional[str]:
+        """None if ``expr`` provably draws from a finite domain, else the
+        reason it is runtime-derived. ``encl`` is the function the
+        expression appears in (its parameters trace to call sites)."""
+        if seen is None:
+            seen = set()
+        if depth > 4:
+            return "call-site trace exceeded depth 4"
+        if isinstance(expr, ast.Constant):
+            return None  # float statics are the hazard rule's business
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for e in expr.elts:
+                r = self.classify(e, encl, depth, seen)
+                if r:
+                    return r
+            return None
+        if isinstance(expr, ast.UnaryOp):
+            return self.classify(expr.operand, encl, depth, seen)
+        if isinstance(expr, ast.BinOp):
+            return self.classify(expr.left, encl, depth, seen) or \
+                self.classify(expr.right, encl, depth, seen)
+        if isinstance(expr, ast.IfExp):
+            return self.classify(expr.body, encl, depth, seen) or \
+                self.classify(expr.orelse, encl, depth, seen)
+        if isinstance(expr, ast.Subscript):
+            return self.classify(expr.value, encl, depth, seen)
+        if isinstance(expr, ast.Attribute):
+            parts = []
+            cur: ast.AST = expr
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                cur = cur.value
+            if "shape" in parts:
+                return None  # array shapes are engine-shape-derived
+            if isinstance(cur, ast.Name) and (
+                cur.id in self.table.finite_attr_bases
+            ):
+                return None
+            src = dotted_name(expr) or "<attribute>"
+            return (
+                f"`{src}` is not rooted at a declared config source "
+                f"({', '.join(sorted(self.table.finite_attr_bases))})"
+            )
+        if isinstance(expr, ast.Call):
+            fname = dotted_name(expr.func)
+            if fname in _FINITE_BUILTINS:
+                for a in expr.args:
+                    r = self.classify(a, encl, depth, seen)
+                    if r:
+                        return r
+                return None
+            return f"value produced by call to `{fname or '<expr>'}`"
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if name in self.table.finite_domains:
+                return None
+            if encl is not None:
+                params = [
+                    a.arg
+                    for a in encl.args.posonlyargs + encl.args.args
+                ]
+                if name in params:
+                    return self._classify_param(
+                        encl, params.index(name), name, depth, seen
+                    )
+            rhs = self.module_assigns.get(name)
+            if rhs is not None:
+                return self.classify(rhs, None, depth + 1, seen)
+            return (
+                f"`{name}` is neither a declared finite domain, a "
+                "traceable parameter, nor a module constant"
+            )
+        return f"unclassifiable expression at line {expr.lineno}"
+
+    def _classify_param(
+        self, fn: ast.AST, pos: int, name: str, depth: int, seen: set
+    ) -> Optional[str]:
+        key = (fn.name, name)
+        if key in seen:
+            return None  # cycle: judged by the other paths
+        seen.add(key)
+        sites = [
+            c for c in self.calls_by_name.get(fn.name, ())
+            if self.enclosing_fn(c) is not fn
+        ]
+        if not sites:
+            return (
+                f"parameter `{name}` of `{fn.name}` has no declared "
+                "finite domain and no same-module call site to trace"
+            )
+        for site in sites:
+            arg = self.call_arg(site, pos, name)
+            if arg is None:
+                continue
+            r = self.classify(
+                arg, self.enclosing_fn(site), depth + 1, seen
+            )
+            if r:
+                return (
+                    f"via `{fn.name}` call at line {site.lineno}: {r}"
+                )
+        return None
+
+    # -- closure-capture support ----------------------------------------------
+
+    def array_consts_in_scope(self, fn: ast.AST) -> Dict[str, int]:
+        """Names assigned array-producing expressions in the module scope
+        or any enclosing function scope of ``fn`` -> assignment line."""
+        out: Dict[str, int] = {}
+
+        def is_array(expr: ast.expr) -> bool:
+            if isinstance(expr, ast.Call):
+                d = dotted_name(expr.func) or ""
+                return any(d.startswith(p) for p in _ARRAY_ROOTS)
+            return False
+
+        for name, rhs in self.module_assigns.items():
+            if is_array(rhs):
+                out[name] = rhs.lineno
+        cur = getattr(fn, "_orion_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for node in ast.walk(cur):
+                    if isinstance(node, ast.Assign) and len(
+                        node.targets
+                    ) == 1 and isinstance(node.targets[0], ast.Name) \
+                            and is_array(node.value):
+                        out.setdefault(
+                            node.targets[0].id, node.value.lineno
+                        )
+            cur = getattr(cur, "_orion_parent", None)
+        return out
+
+
+def _model(ctx: ModuleContext, table: ProgramTable) -> ProgramModel:
+    cached = getattr(ctx, "_orion_program_model", None)
+    if cached is None or cached.table is not table:
+        cached = ProgramModel(ctx, table)
+        ctx._orion_program_model = cached  # type: ignore[attr-defined]
+    return cached
+
+
+# -- the per-module rules ------------------------------------------------------
+
+
+class _TierERule:
+    def __init__(self, table: Optional[ProgramTable] = None):
+        self._table = table
+
+    @property
+    def table(self) -> ProgramTable:
+        return self._table if self._table is not None else \
+            load_program_table()
+
+    def _skip(self, ctx: ModuleContext) -> bool:
+        return ctx.is_test
+
+
+class UnregisteredJitRule(_TierERule):
+    id = RULE_UNREGISTERED
+    title = "jit/shard_map site with no analysis/programs.py declaration"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self._skip(ctx):
+            return
+        model = _model(ctx, self.table)
+        for fn, _dec in model.jit_defs:
+            if self.table.decl_at(ctx.path, fn.name) is None:
+                yield Finding(
+                    self.id, ctx.path, fn.lineno,
+                    f"jitted function `{fn.name}` is not a declared "
+                    "program — every executable the fleet compiles must "
+                    "have a ProgramDecl row in analysis/programs.py "
+                    "(section decode/solo/setup/training) so the AOT "
+                    "store can plan it",
+                )
+        for call, qualname in model.bare_sites:
+            if self.table.decl_at(ctx.path, qualname) is None:
+                what = dotted_name(call.func) or "jit"
+                yield Finding(
+                    self.id, ctx.path, call.lineno,
+                    f"`{what}` call site in `{qualname}` is not a "
+                    "declared program — declare the enclosing function "
+                    "in analysis/programs.py or route through a "
+                    "registered wrapper",
+                )
+
+
+class UnboundedStaticKeyRule(_TierERule):
+    id = RULE_UNBOUNDED
+    title = "static jit argument outside every declared finite domain"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self._skip(ctx):
+            return
+        model = _model(ctx, self.table)
+        for fn, dec in model.jit_defs:
+            decl = self.table.decl_at(ctx.path, fn.name)
+            if decl is None:
+                continue  # unregistered-jit owns that finding
+            sp = model.static_params(fn, dec)
+            names = tuple(p for _, p in sp)
+            if tuple(decl.static_args) != names:
+                yield Finding(
+                    self.id, ctx.path, fn.lineno,
+                    f"`{fn.name}` static signature {names!r} drifted "
+                    f"from the declared static_args "
+                    f"{tuple(decl.static_args)!r} — update the "
+                    "ProgramDecl so the key-space claim matches the code",
+                )
+                continue
+            if decl.keyspace == "open":
+                continue
+            for pos, pname in sp:
+                if pname in self.table.finite_domains:
+                    continue
+                sites = model.calls_by_name.get(fn.name, ())
+                if not sites:
+                    yield Finding(
+                        self.id, ctx.path, fn.lineno,
+                        f"static arg `{pname}` of `{fn.name}` has no "
+                        "declared finite domain "
+                        "(programs.FINITE_DOMAINS) and no same-module "
+                        "call site to trace",
+                    )
+                    continue
+                for site in sites:
+                    arg = model.call_arg(site, pos, pname)
+                    if arg is None:
+                        continue
+                    reason = model.classify(
+                        arg, model.enclosing_fn(site)
+                    )
+                    if reason:
+                        yield Finding(
+                            self.id, ctx.path, site.lineno,
+                            f"static arg `{pname}` of `{fn.name}` is "
+                            f"runtime-derived here: {reason} — an "
+                            "unbounded key space means a cold replica "
+                            "pays surprise compiles mid-traffic; pass a "
+                            "declared finite value or add the domain to "
+                            "programs.FINITE_DOMAINS with a rationale",
+                        )
+
+
+class RecompileHazardRule(_TierERule):
+    id = RULE_HAZARD
+    title = "silent compile-cache blowup shape"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self._skip(ctx):
+            return
+        model = _model(ctx, self.table)
+        # (a) closure capture of arrays in a jitted function
+        for fn, dec in model.jit_defs:
+            arrays = model.array_consts_in_scope(fn)
+            if not arrays:
+                continue
+            local = set(
+                a.arg for a in fn.args.posonlyargs + fn.args.args
+            )
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            local.add(t.id)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load
+                ) and node.id in arrays and node.id not in local:
+                    yield Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"jitted `{fn.name}` closes over array "
+                        f"`{node.id}` (assigned at line "
+                        f"{arrays[node.id]}) — the value is baked into "
+                        "the trace and every rebind retraces; pass it "
+                        "as an argument",
+                    )
+        # (b)+(c) hazardous expressions feeding static positions
+        for fn, dec in model.jit_defs:
+            for pos, pname in model.static_params(fn, dec):
+                for site in model.calls_by_name.get(fn.name, ()):
+                    arg = model.call_arg(site, pos, pname)
+                    if arg is None:
+                        continue
+                    for f in self._static_expr_hazards(
+                        ctx, fn.name, pname, site, arg
+                    ):
+                        yield f
+        # (d) functools.partial re-wrapping a registered wrapper
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            fname = dotted_name(call.func)
+            if fname not in ("partial", "functools.partial"):
+                continue
+            if not call.args or not isinstance(call.args[0], ast.Name):
+                continue
+            target = call.args[0].id
+            if target not in self.table.qualnames:
+                continue
+            if model.enclosing_fn(call) is None:
+                continue  # module-level partial: one object, one cache
+            yield Finding(
+                self.id, ctx.path, call.lineno,
+                f"functools.partial re-wraps registered jit `{target}` "
+                "inside a function body — each call builds a fresh "
+                "callable, so re-jitting or tracing it forks the "
+                "compile cache; call the registered wrapper directly",
+            )
+
+    def _static_expr_hazards(self, ctx, fname, pname, site, arg):
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Constant) and isinstance(
+                sub.value, float
+            ):
+                yield Finding(
+                    self.id, ctx.path, site.lineno,
+                    f"float literal {sub.value!r} feeds static arg "
+                    f"`{pname}` of `{fname}` — float keys accumulate "
+                    "near-duplicate cache entries; use an int or a "
+                    "declared enum",
+                )
+            elif isinstance(sub, ast.Call):
+                d = dotted_name(sub.func)
+                attr = sub.func.attr if isinstance(
+                    sub.func, ast.Attribute
+                ) else ""
+                if d == "float":
+                    yield Finding(
+                        self.id, ctx.path, site.lineno,
+                        f"float() feeds static arg `{pname}` of "
+                        f"`{fname}` — float keys accumulate "
+                        "near-duplicate cache entries",
+                    )
+                elif attr in ("keys", "values", "items") or d in (
+                    "set", "frozenset"
+                ):
+                    yield Finding(
+                        self.id, ctx.path, site.lineno,
+                        f"dict/set iteration feeds static arg "
+                        f"`{pname}` of `{fname}` — iteration order is "
+                        "insertion/hash-dependent, so equal contents "
+                        "can produce distinct static keys; sort into a "
+                        "tuple first",
+                    )
+
+
+def program_rules(table: Optional[ProgramTable] = None) -> List:
+    return [
+        UnregisteredJitRule(table),
+        UnboundedStaticKeyRule(table),
+        RecompileHazardRule(table),
+    ]
+
+
+# -- repo-level checks: registry, plan, donation -------------------------------
+
+
+def _repo_root(root: str = "") -> str:
+    return root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def registry_drift_findings(
+    table: Optional[ProgramTable] = None, root: str = ""
+) -> List[Finding]:
+    """generate.DECODE_PROGRAMS (parsed from the AST, never imported)
+    diffed against the declared decode section — both directions."""
+    table = table or load_program_table()
+    root = _repo_root(root)
+    path = _decls.GENERATE
+    try:
+        with open(os.path.join(root, path), encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError) as e:
+        return [Finding(RULE_PLAN, path, 0,
+                        f"cannot parse DECODE_PROGRAMS registry: {e}")]
+    reg: Dict[str, str] = {}
+    lineno = 0
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "DECODE_PROGRAMS" and \
+                isinstance(node.value, ast.Dict):
+            lineno = node.lineno
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(
+                    v, ast.Name
+                ):
+                    reg[k.value] = v.id
+    out: List[Finding] = []
+    if not reg:
+        return [Finding(RULE_PLAN, path, 0,
+                        "DECODE_PROGRAMS dict not found — the serving "
+                        "program registry moved; update Tier E")]
+    declared = {d.name: d for d in table.section("decode")}
+    for name, qual in sorted(reg.items()):
+        d = declared.get(name)
+        if d is None:
+            out.append(Finding(
+                RULE_PLAN, path, lineno,
+                f"DECODE_PROGRAMS entry `{name}` has no decode-section "
+                "ProgramDecl — declare it (with plan applicability) in "
+                "analysis/programs.py",
+            ))
+        elif d.qualname != qual:
+            out.append(Finding(
+                RULE_PLAN, path, lineno,
+                f"DECODE_PROGRAMS maps `{name}` to `{qual}` but the "
+                f"declaration names `{d.qualname}`",
+            ))
+    for name in sorted(set(declared) - set(reg)):
+        out.append(Finding(
+            RULE_PLAN, path, lineno,
+            f"declared decode program `{name}` is missing from "
+            "DECODE_PROGRAMS — a dead declaration mutes the audit",
+        ))
+    return out
+
+
+# canonical-footprint lowering reports, memoized process-wide: the
+# lowering half of Tier E costs seconds once and nothing after (the
+# tier's <45s budget is pinned in tests/test_analysis.py)
+_PLAN_MEMO: Dict[str, Dict[str, Any]] = {}
+
+_IDENT_FIELDS = (
+    "kind", "slots", "chunk", "bucket", "prefill_chunk", "qmode", "tp",
+    "spec_depth",
+)
+
+
+def _ident(entry: Dict[str, Any]) -> Tuple:
+    return tuple(
+        (k, entry[k]) for k in _IDENT_FIELDS if k in entry
+    )
+
+
+def _fp_args(fp: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in fp.items() if k != "expect_programs"}
+
+
+def _default_inventory(fp: Dict[str, Any], lower: bool) -> Dict[str, Any]:
+    from orion_tpu.aot import decode_plan
+    from orion_tpu.models.configs import get_config
+
+    key = repr(sorted(fp.items())) + f" lower={lower}"
+    got = _PLAN_MEMO.get(key)
+    if got is None:
+        got = decode_plan(
+            get_config("tiny"), compile_step=False, lower=lower,
+            **_fp_args(fp),
+        )
+        _PLAN_MEMO[key] = got
+    return got
+
+
+def plan_drift_findings(
+    table: Optional[ProgramTable] = None,
+    footprints=None,
+    inventory_fn=None,
+    lower: bool = True,
+) -> List[Finding]:
+    """Diff ``aot.decode_plan``'s inventory against the declared universe
+    per check footprint; with ``lower=True`` the FIRST footprint is also
+    lowered (memoized) so a planned program that fails to lower is a
+    finding. ``inventory_fn(footprint) -> report`` injects a plan for
+    tests (a deliberately stale one must produce findings)."""
+    table = table or load_program_table()
+    if footprints is None:
+        footprints = _decls.CHECK_FOOTPRINTS
+    out: List[Finding] = []
+    for i, fp in enumerate(footprints):
+        do_lower = lower and i == 0 and inventory_fn is None
+        try:
+            report = (
+                inventory_fn(fp) if inventory_fn is not None
+                else _default_inventory(fp, do_lower)
+            )
+        except Exception as e:  # the audit must never crash on the plan
+            out.append(Finding(
+                RULE_PLAN, "<decode-plan>", 0,
+                f"decode_plan failed for footprint {_fp_args(fp)!r}: "
+                f"{type(e).__name__}: {e}",
+            ))
+            continue
+        expected = _decls.expected_decode_universe(
+            slots=fp["slots"], chunk=fp["chunk"],
+            prefill_buckets=fp.get("prefill_buckets", ()),
+            prefill_chunk=report.get(
+                "prefill_chunk_aligned", fp.get("prefill_chunk", 0)
+            ),
+            qmode=fp.get("qmode", "off"), tp=fp.get("tp", 1),
+            spec_depth=fp.get("spec_depth", 0), decls=table.decls,
+        )
+        want = fp.get("expect_programs")
+        if want is not None and len(expected) != want:
+            out.append(Finding(
+                RULE_PLAN, "<decode-plan>", 0,
+                f"declared universe for footprint {_fp_args(fp)!r} has "
+                f"{len(expected)} programs, CHECK_FOOTPRINTS expects "
+                f"{want} — update the declaration",
+            ))
+        inv = {_ident(p): p for p in report.get("programs", ())}
+        exp = {_ident(e): e for e in expected}
+        for key in sorted(set(exp) - set(inv)):
+            out.append(Finding(
+                RULE_PLAN, "<decode-plan>", 0,
+                f"declared program missing from decode_plan inventory "
+                f"(footprint {_fp_args(fp)!r}): {dict(key)!r} — a cold "
+                "replica would compile it mid-traffic",
+            ))
+        for key in sorted(set(inv) - set(exp)):
+            out.append(Finding(
+                RULE_PLAN, "<decode-plan>", 0,
+                f"decode_plan lists a program outside the declared "
+                f"universe (footprint {_fp_args(fp)!r}): {dict(key)!r} "
+                "— a phantom entry breaks the warm-start contract",
+            ))
+        if do_lower:
+            for p in report.get("programs", ()):
+                if p.get("error"):
+                    out.append(Finding(
+                        RULE_PLAN, "<decode-plan>", 0,
+                        f"planned program {p.get('kind')} fails to "
+                        f"lower: {p['error']}",
+                    ))
+    return out
+
+
+def donation_drift_findings(
+    table: Optional[ProgramTable] = None,
+    root: str = "",
+    golden_dir: Optional[str] = None,
+) -> List[Finding]:
+    """Three-way donate_argnums check per declared program: decorator AST
+    vs declaration vs the golden snapshots' recorded donation counts."""
+    table = table or load_program_table()
+    root = _repo_root(root)
+    if golden_dir is None:
+        golden_dir = os.path.join(os.path.dirname(__file__), "golden")
+    out: List[Finding] = []
+    trees: Dict[str, Optional[ast.AST]] = {}
+    for d in table.decls:
+        tree = trees.get(d.module, False)
+        if tree is False:
+            try:
+                with open(
+                    os.path.join(root, d.module), encoding="utf-8"
+                ) as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                tree = None
+            trees[d.module] = tree
+        if tree is not None:
+            for fn in ast.walk(tree):
+                if isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and fn.name == d.qualname and jit_decorations(fn):
+                    dec = jit_decorations(fn)[0]
+                    donated: Tuple[int, ...] = ()
+                    if isinstance(dec, ast.Call):
+                        for kw in dec.keywords:
+                            if kw.arg == "donate_argnums":
+                                v = kw.value
+                                elts = v.elts if isinstance(
+                                    v, (ast.Tuple, ast.List)
+                                ) else [v]
+                                donated = tuple(
+                                    e.value for e in elts
+                                    if isinstance(e, ast.Constant)
+                                )
+                    if donated != tuple(d.donate_argnums):
+                        out.append(Finding(
+                            RULE_DONATION, d.module, fn.lineno,
+                            f"`{d.qualname}` donates {donated!r} but "
+                            "the declaration says "
+                            f"{tuple(d.donate_argnums)!r} — a dropped "
+                            "donation is a silent memory regression; "
+                            "fix the code or the ProgramDecl",
+                        ))
+                    break
+        for g in d.goldens:
+            gpath = os.path.join(golden_dir, f"{g}.json")
+            rel = normalize_path(gpath, root)
+            try:
+                with open(gpath, encoding="utf-8") as f:
+                    snap = json.load(f)
+            except (OSError, ValueError):
+                out.append(Finding(
+                    RULE_DONATION, rel, 0,
+                    f"golden snapshot `{g}` pinning `{d.name}` donation "
+                    "is missing/unreadable — regenerate with "
+                    "--update-golden",
+                ))
+                continue
+            got = int(
+                snap.get("donation", {}).get("donated_args", 0)
+            )
+            if bool(got) != bool(d.donate_argnums):
+                out.append(Finding(
+                    RULE_DONATION, rel, 0,
+                    f"golden `{g}` records {got} donated args but the "
+                    f"declaration for `{d.name}` says "
+                    f"{tuple(d.donate_argnums)!r} — donation drifted "
+                    "between the compiled artifact and the registry",
+                ))
+    return out
+
+
+# -- tier entry points ---------------------------------------------------------
+
+
+def audit_programs(
+    paths=None,
+    root: str = "",
+    baseline: Tuple[BaselineEntry, ...] = (),
+    keep_suppressed: bool = False,
+    table: Optional[ProgramTable] = None,
+    lower: bool = True,
+    golden_dir: Optional[str] = None,
+) -> List[Finding]:
+    """Run Tier E over the program packages (or explicit paths)."""
+    root = _repo_root(root)
+    if paths is None:
+        paths = [os.path.join(root, p) for p in TIER_E_PATHS]
+    fs = lint_paths(
+        paths, rules=program_rules(table), root=root, keep_suppressed=True,
+    )
+    fs += registry_drift_findings(table, root)
+    fs += donation_drift_findings(table, root, golden_dir)
+    fs += plan_drift_findings(table, lower=lower)
+    fs.sort(key=lambda f: (f.path, f.line, f.rule))
+    if keep_suppressed:
+        return annotate_baseline(fs, baseline)
+    return [
+        f for f in apply_baseline(fs, baseline)
+        if f.status != "suppressed"
+    ]
+
+
+def audit_source(
+    source: str, path: str, table: Optional[ProgramTable] = None
+) -> List[Finding]:
+    """Tier E's per-module rules over one in-memory module (the test
+    fixture entry point; the repo-level plan/donation checks are their
+    own functions)."""
+    from orion_tpu.analysis.lint import lint_source
+
+    return lint_source(source, path, rules=program_rules(table))
+
+
+__all__ = [
+    "ALL_PROGRAM_CHECKS", "ProgramModel", "ProgramTable",
+    "audit_programs", "audit_source", "program_rules",
+    "load_program_table", "registry_drift_findings",
+    "plan_drift_findings", "donation_drift_findings", "TIER_E_PATHS",
+    "RULE_UNREGISTERED", "RULE_UNBOUNDED", "RULE_HAZARD", "RULE_PLAN",
+    "RULE_DONATION",
+]

@@ -1,0 +1,213 @@
+"""Stale-suppression audit (ISSUE 18 satellite): suppressions must decay.
+
+A ``# orion: noqa[rule-id]`` that no longer suppresses anything, or a
+baseline.json entry whose (rule, path) no longer matches any finding, is a
+muted alarm wired to nothing — it hides the NEXT genuine finding at that
+site. After the tiers run, this module re-examines every suppression
+against the findings that were actually produced (``keep_suppressed``
+mode, so live noqas show up as ``status="suppressed"``) and reports the
+dead ones:
+
+- **stale-noqa** — a noqa comment whose rule ids produced no finding on
+  its logical line. Only ids belonging to rules that actually RAN this
+  invocation are judged (a ``--tier lint`` run must not call a Tier D
+  noqa stale); bare ``# orion: noqa`` and unknown rule ids are judged
+  only on a full run (``--tier all`` over the whole package).
+- **dead-baseline-entry** — a baseline entry whose rule ran over its
+  file and produced nothing. ``--prune-baseline`` rewrites the baseline
+  minus the dead entries, preserving the rationales of the live ones.
+
+Suppression comments are found by TOKENIZING, not by regexing raw lines:
+the noqa pattern appears inside docstrings and string literals all over
+the analysis package itself, and only a real COMMENT token is a
+suppression."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tokenize
+from typing import Dict, FrozenSet, Iterable, List, Sequence, Set, Tuple
+
+from orion_tpu.analysis.findings import (
+    BaselineEntry,
+    Finding,
+    normalize_path,
+)
+from orion_tpu.analysis.lint import (
+    NOQA_ALL,
+    NOQA_RE,
+    ModuleContext,
+    iter_py_files,
+)
+
+RULE_STALE_NOQA = "stale-noqa"
+RULE_DEAD_BASELINE = "dead-baseline-entry"
+
+ALL_STALENESS_CHECKS = (RULE_STALE_NOQA, RULE_DEAD_BASELINE)
+
+
+def _noqa_comments(source: str) -> List[Tuple[int, FrozenSet[str]]]:
+    """(line, rule ids) for each REAL ``# orion: noqa`` comment token."""
+    out: List[Tuple[int, FrozenSet[str]]] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = NOQA_RE.search(tok.string)
+            if not m:
+                continue
+            ids = m.group(1)
+            out.append((
+                tok.start[0],
+                frozenset(
+                    s.strip() for s in ids.split(",") if s.strip()
+                ) if ids else NOQA_ALL,
+            ))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return []  # unparseable file: the parse-error finding owns it
+    return out
+
+
+def stale_noqa_findings(
+    findings: Sequence[Finding],
+    paths: Sequence[str],
+    ran_rule_ids: Iterable[str],
+    root: str = "",
+    full: bool = False,
+) -> List[Finding]:
+    """Judge every noqa comment under ``paths`` against ``findings``
+    (which must include suppressed ones — a suppressed finding is the
+    proof its noqa is alive)."""
+    ran = frozenset(ran_rule_ids)
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        comments = _noqa_comments(source)
+        if not comments:
+            continue
+        try:
+            ctx = ModuleContext(source, path, root)
+        except SyntaxError:
+            continue
+        if ctx.is_test:
+            continue  # fixture noqas in tests are data, not suppressions
+        file_findings = by_path.get(ctx.path, [])
+        for line, ids in comments:
+            span = ctx.logical_lines.get(line, range(line, line + 1))
+            hit_rules: Set[str] = {
+                f.rule for f in file_findings if f.line in span
+            }
+            if ids is NOQA_ALL:
+                if full and not hit_rules:
+                    out.append(Finding(
+                        RULE_STALE_NOQA, ctx.path, line,
+                        "bare `# orion: noqa` suppresses nothing on this "
+                        "line — remove it (and prefer targeted "
+                        "`noqa[rule-id]` if it ever comes back)",
+                    ))
+                continue
+            for rid in sorted(ids):
+                if rid in ran:
+                    if rid not in hit_rules:
+                        out.append(Finding(
+                            RULE_STALE_NOQA, ctx.path, line,
+                            f"`# orion: noqa[{rid}]` no longer "
+                            "suppresses anything — the finding it muted "
+                            "is gone; remove the comment so the next "
+                            f"real `{rid}` here is not silently eaten",
+                        ))
+                elif full:
+                    out.append(Finding(
+                        RULE_STALE_NOQA, ctx.path, line,
+                        f"`# orion: noqa[{rid}]` names a rule id no "
+                        "tier defines — a typo here mutes nothing and "
+                        "hides intent; fix or remove it",
+                    ))
+    return out
+
+
+def dead_baseline_entries(
+    findings: Sequence[Finding],
+    baseline: Sequence[BaselineEntry],
+    ran_rule_ids: Iterable[str],
+    audited_paths: Sequence[str] = (),
+) -> List[BaselineEntry]:
+    """Entries whose rule ran over their file yet matched nothing.
+    ``findings`` must be the keep-suppressed/annotated set (baselined
+    findings prove their entry is alive). ``audited_paths`` are
+    repo-relative prefixes this run actually covered; entries outside
+    them are never judged."""
+    ran = frozenset(ran_rule_ids)
+    live = {(f.rule, f.path) for f in findings}
+    prefixes = tuple(p.rstrip("/") for p in audited_paths)
+
+    def audited(path: str) -> bool:
+        if not prefixes:
+            return True
+        return any(
+            path == p or path.startswith(p + "/") for p in prefixes
+        )
+
+    return [
+        b for b in baseline
+        if b.rule in ran and audited(b.path)
+        and (b.rule, b.path) not in live
+    ]
+
+
+def dead_baseline_findings(
+    dead: Sequence[BaselineEntry], baseline_path: str, root: str = ""
+) -> List[Finding]:
+    rel = normalize_path(baseline_path, root)
+    return [
+        Finding(
+            RULE_DEAD_BASELINE, rel, 0,
+            f"baseline entry (rule `{b.rule}`, path `{b.path}`) matches "
+            "no finding — the grandfathered problem is fixed; remove "
+            "the entry (or rerun with --prune-baseline) so the next "
+            f"`{b.rule}` in that file gates again. Rationale was: "
+            f"{b.reason}",
+        )
+        for b in dead
+    ]
+
+
+def prune_baseline(
+    baseline_path: str, dead: Sequence[BaselineEntry]
+) -> int:
+    """Rewrite the baseline minus ``dead``, preserving the reasons (and
+    any unknown keys) of surviving entries verbatim. Returns the number
+    of entries removed."""
+    if not dead or not os.path.exists(baseline_path):
+        return 0
+    with open(baseline_path, encoding="utf-8") as f:
+        data = json.load(f)
+    drop = {(b.rule, b.path) for b in dead}
+    kept = [
+        e for e in data.get("entries", [])
+        if (e.get("rule"), e.get("path")) not in drop
+    ]
+    removed = len(data.get("entries", [])) - len(kept)
+    if removed:
+        data["entries"] = kept
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")
+    return removed
+
+
+__all__ = [
+    "ALL_STALENESS_CHECKS", "RULE_DEAD_BASELINE", "RULE_STALE_NOQA",
+    "dead_baseline_entries", "dead_baseline_findings", "prune_baseline",
+    "stale_noqa_findings",
+]

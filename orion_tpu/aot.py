@@ -337,6 +337,7 @@ def decode_plan(
     tp: int = 0,
     spec_depth: int = 0,
     compile_step: bool = True,
+    lower: bool = True,
 ) -> Dict[str, Any]:
     """The SERVING-side inventory ``plan`` never had (ISSUE 14): every
     decode/prefill executable a replica of this shape compiles, keyed
@@ -350,65 +351,27 @@ def decode_plan(
     Per program: the GSPMD collectives (for tp plans: the two
     per-block all-reduces per decode step — evidence the mesh engaged)
     and the compiler's code size, the artifact a warm-start cache would
-    key and store."""
-    import jax.numpy as jnp
-
-    from orion_tpu.generate import (
-        SampleConfig,
-        _decode_batched_chunk_jit,
-        _decode_batched_prefill_chunk_jit,
-        _decode_batched_spec_round_jit,
-        _prefill_carry_bucketed_jit,
-    )
-
+    key and store. ``lower=False`` skips lowering entirely and returns
+    the pure inventory (identity keys only) — the cheap side Tier E's
+    plan-drift rule and :func:`verify_decode_plan` diff against the
+    declared universe."""
     tp = max(int(tp), 1)
-    model, params, carry, rngs, active, shaped = _decode_abstracts(
-        model_cfg, slots, qmode, tp
-    )
-    vec = lambda dt: shaped((slots,), dt)  # noqa: E731
-    sample = SampleConfig()
     base_key = {"slots": slots, "chunk": chunk, "qmode": qmode, "tp": tp}
 
-    programs = []
+    # pass 1: the pure inventory — entry identities plus deferred
+    # lowering thunks, NO jax work yet (thunks only run in pass 2)
+    programs: list = []
+    jobs: list = []
 
-    def add(kind: str, key: Dict[str, Any], lower):
+    def add(kind: str, key: Dict[str, Any], lower_thunk) -> None:
         entry: Dict[str, Any] = {"kind": kind, **key}
-        try:
-            lowered = lower()
-            entry["lowered"] = True
-            try:
-                # the cost-ledger figures (ISSUE 15) ride the inventory
-                # too: the warm-start program list doubles as the fleet's
-                # per-program price sheet
-                entry["cost"] = _lowered_cost(lowered)
-            except Exception as e:
-                entry["cost_error"] = f"{type(e).__name__}: {e}"[:120]
-            if compile_step:
-                compiled = lowered.compile()
-                entry["compiled"] = True
-                try:
-                    entry["collectives"] = _collective_counts(
-                        compiled.as_text()
-                    )
-                except Exception as e:
-                    entry["collectives_error"] = (
-                        f"{type(e).__name__}: {e}"[:120]
-                    )
-                try:
-                    ma = compiled.memory_analysis()
-                    if ma is not None:
-                        v = getattr(ma, "generated_code_size_in_bytes", None)
-                        if v is not None:
-                            entry["generated_code_size_in_bytes"] = int(v)
-                except Exception:
-                    pass
-        except Exception as e:  # surface, never crash the inventory
-            entry["error"] = f"{type(e).__name__}: {e}"[:200]
         programs.append(entry)
+        jobs.append((entry, lower_thunk))
 
-    add("decode_batched", dict(base_key), lambda: (
-        _decode_batched_chunk_jit.lower(
-            model, params, carry, rngs, active, int(chunk), sample
+    add("decode_batched", dict(base_key), lambda env: (
+        env["decode_batched"].lower(
+            env["model"], env["params"], env["carry"], env["rngs"],
+            env["active"], int(chunk), env["sample"],
         )
     ))
     # the engine's in-scan piece boundaries align to the linear-attention
@@ -424,16 +387,17 @@ def decode_plan(
         )
         pchunk = -(-int(prefill_chunk) // align) * align
     for bucket in prefill_buckets or ():
-        pbuf = shaped((slots, int(bucket)), jnp.int32)
         if pchunk:
             add(
                 "unified_prefill",
                 dict(base_key, bucket=int(bucket), prefill_chunk=pchunk),
-                lambda pbuf=pbuf, pchunk=pchunk: (
-                    _decode_batched_prefill_chunk_jit.lower(
-                        model, params, carry, rngs, active, pbuf,
-                        vec(jnp.int32), vec(jnp.int32), int(chunk), pchunk,
-                        sample,
+                lambda env, bucket=bucket, pchunk=pchunk: (
+                    env["unified_prefill"].lower(
+                        env["model"], env["params"], env["carry"],
+                        env["rngs"], env["active"],
+                        env["shaped"]((slots, int(bucket)), env["i32"]),
+                        env["vec"](env["i32"]), env["vec"](env["i32"]),
+                        int(chunk), pchunk, env["sample"],
                     )
                 ),
             )
@@ -442,10 +406,13 @@ def decode_plan(
         add(
             "prefill_bucketed",
             {"bucket": int(bucket), "qmode": qmode, "tp": tp},
-            lambda bucket=bucket: _prefill_carry_bucketed_jit.lower(
-                model, params, shaped((1, int(bucket)), jnp.int32), sample,
-                shaped((2,), jnp.uint32), shaped((), jnp.int32),
-                shaped((1,), jnp.bool_), shaped((), jnp.int32),
+            lambda env, bucket=bucket: env["prefill_bucketed"].lower(
+                env["model"], env["params"],
+                env["shaped"]((1, int(bucket)), env["i32"]), env["sample"],
+                env["shaped"]((2,), env["u32"]),
+                env["shaped"]((), env["i32"]),
+                env["shaped"]((1,), env["bool"]),
+                env["shaped"]((), env["i32"]),
             ),
         )
     if spec_depth:
@@ -453,11 +420,75 @@ def decode_plan(
             "spec_round",
             {"slots": slots, "spec_depth": int(spec_depth),
              "qmode": qmode, "tp": tp},
-            lambda: _decode_batched_spec_round_jit.lower(
-                model, params, carry, rngs, active, vec(jnp.bool_),
-                int(spec_depth), sample,
+            lambda env: env["spec_round"].lower(
+                env["model"], env["params"], env["carry"], env["rngs"],
+                env["active"], env["vec"](env["bool"]),
+                int(spec_depth), env["sample"],
             ),
         )
+
+    # pass 2: lower (and optionally compile) each planned program
+    if lower:
+        import jax.numpy as jnp
+
+        from orion_tpu.generate import (
+            SampleConfig,
+            _decode_batched_chunk_jit,
+            _decode_batched_prefill_chunk_jit,
+            _decode_batched_spec_round_jit,
+            _prefill_carry_bucketed_jit,
+        )
+
+        model, params, carry, rngs, active, shaped = _decode_abstracts(
+            model_cfg, slots, qmode, tp
+        )
+        env = {
+            "model": model, "params": params, "carry": carry,
+            "rngs": rngs, "active": active, "shaped": shaped,
+            "vec": lambda dt: shaped((slots,), dt),
+            "sample": SampleConfig(),
+            "i32": jnp.int32, "u32": jnp.uint32, "bool": jnp.bool_,
+            "decode_batched": _decode_batched_chunk_jit,
+            "unified_prefill": _decode_batched_prefill_chunk_jit,
+            "prefill_bucketed": _prefill_carry_bucketed_jit,
+            "spec_round": _decode_batched_spec_round_jit,
+        }
+        for entry, thunk in jobs:
+            try:
+                lowered = thunk(env)
+                entry["lowered"] = True
+                try:
+                    # the cost-ledger figures (ISSUE 15) ride the
+                    # inventory too: the warm-start program list doubles
+                    # as the fleet's per-program price sheet
+                    entry["cost"] = _lowered_cost(lowered)
+                except Exception as e:
+                    entry["cost_error"] = f"{type(e).__name__}: {e}"[:120]
+                if compile_step:
+                    compiled = lowered.compile()
+                    entry["compiled"] = True
+                    try:
+                        entry["collectives"] = _collective_counts(
+                            compiled.as_text()
+                        )
+                    except Exception as e:
+                        entry["collectives_error"] = (
+                            f"{type(e).__name__}: {e}"[:120]
+                        )
+                    try:
+                        ma = compiled.memory_analysis()
+                        if ma is not None:
+                            v = getattr(
+                                ma, "generated_code_size_in_bytes", None
+                            )
+                            if v is not None:
+                                entry["generated_code_size_in_bytes"] = (
+                                    int(v)
+                                )
+                    except Exception:
+                        pass
+            except Exception as e:  # surface, never crash the inventory
+                entry["error"] = f"{type(e).__name__}: {e}"[:200]
     return {
         "config": model_cfg.name,
         "qmode": qmode,
@@ -465,9 +496,43 @@ def decode_plan(
         "slots": slots,
         "chunk": chunk,
         "prefill_buckets": list(prefill_buckets or ()),
+        "prefill_chunk_aligned": pchunk,
+        "spec_depth": int(spec_depth),
         "n_programs": len(programs),
         "programs": programs,
     }
+
+
+def verify_decode_plan(report: Dict[str, Any]) -> list:
+    """Diff a :func:`decode_plan` report against the DECLARED universe
+    (``analysis/programs.py`` — ``expected_decode_universe`` reproduces
+    the plan from each decode row's ``plan`` applicability). Returns
+    human-readable mismatch strings, empty when plan == declarations —
+    the ``--decode --verify`` gate Tier E's plan-drift rule mirrors."""
+    from orion_tpu.analysis import programs as _decls
+    from orion_tpu.analysis.program_audit import _ident
+
+    expected = _decls.expected_decode_universe(
+        slots=report["slots"], chunk=report["chunk"],
+        prefill_buckets=tuple(report.get("prefill_buckets", ())),
+        prefill_chunk=report.get("prefill_chunk_aligned", 0),
+        qmode=report["qmode"], tp=report["tp"],
+        spec_depth=report.get("spec_depth", 0),
+    )
+    inv = {_ident(p) for p in report.get("programs", ())}
+    exp = {_ident(e) for e in expected}
+    msgs = [
+        f"declared program missing from plan: {dict(k)!r}"
+        for k in sorted(exp - inv)
+    ] + [
+        f"planned program not in declared universe: {dict(k)!r}"
+        for k in sorted(inv - exp)
+    ]
+    msgs += [
+        f"planned program fails to lower: {p.get('kind')}: {p['error']}"
+        for p in report.get("programs", ()) if p.get("error")
+    ]
+    return msgs
 
 
 def main(argv=None) -> int:
@@ -508,6 +573,10 @@ def main(argv=None) -> int:
                    help="bucket spec as in serving (pow2 | a,b,c | off)")
     p.add_argument("--qmode", default="off", choices=["off", "int8", "int4"])
     p.add_argument("--spec-depth", type=int, default=0)
+    p.add_argument("--verify", action="store_true",
+                   help="with --decode: assert the plan inventory exactly "
+                        "matches the declared program universe "
+                        "(analysis/programs.py) — exit 1 on drift")
     args = p.parse_args(argv)
 
     if args.topology:
@@ -551,6 +620,13 @@ def main(argv=None) -> int:
             spec_depth=args.spec_depth,
             compile_step=not args.lower_only,
         )
+        if args.verify:
+            mismatches = verify_decode_plan(report)
+            report["verified"] = not mismatches
+            print(json.dumps(report))
+            for m in mismatches:
+                print(f"decode-plan verify: {m}", file=sys.stderr)
+            return 1 if mismatches else 0
         print(json.dumps(report))
         return 0
     seq_len = args.seq_len or model.max_seq_len

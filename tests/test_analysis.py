@@ -1773,3 +1773,209 @@ def test_cli_subprocess_whole_repo_exits_zero():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
+
+
+# ---------------------------------------------------------------------------
+# Tier E gate + per-tier summary + staleness audit (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def test_tier_e_whole_repo_clean_within_budget():
+    """Tier E (with the memoized lowering pass) over the real tree: zero
+    findings, cold run inside the 45s budget, memoized rerun near-free.
+    This IS the tier-1 quick gate for the compile-universe audit."""
+    import time
+
+    from orion_tpu.analysis import program_audit
+
+    program_audit._PLAN_MEMO.clear()
+    t0 = time.perf_counter()
+    findings = program_audit.audit_programs()
+    cold = time.perf_counter() - t0
+    assert findings == [], "\n".join(f.format() for f in findings)
+    assert cold < 45.0, f"Tier E cold run took {cold:.1f}s (budget 45s)"
+    t0 = time.perf_counter()
+    program_audit.audit_programs()
+    warm = time.perf_counter() - t0
+    assert warm < 10.0, f"memoized Tier E rerun took {warm:.1f}s"
+
+
+def test_cli_tier_programs_exits_zero_with_self_time(capsys):
+    """Acceptance: `--tier programs` exits 0 on the repo, and --self-time
+    covers Tier E."""
+    from orion_tpu.analysis.__main__ import main
+
+    rc = main(["--tier", "programs", "--self-time"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+    assert "self-time: tier E" in out.err
+    assert "self-time: total" in out.err
+
+
+def test_cli_json_per_tier_summary_trailer(tmp_path, capsys):
+    """The json document carries a per-tier "tiers" trailer with counts
+    and wall time — pinned so CI consumers can rely on the shape."""
+    from orion_tpu.analysis.__main__ import main
+
+    mod = tmp_path / "orion_tiers.py"
+    mod.write_text(
+        "def f(x=[]):\n"
+        "    return x\n"
+        "def g(x=[]):  # orion: noqa[mutable-default]\n"
+        "    return x\n"
+    )
+    rc = main([str(mod), "--tier", "lint", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [t["tier"] for t in doc["tiers"]] == ["lint"]
+    row = doc["tiers"][0]
+    assert row["label"] == "tier A"
+    assert row["active"] == 1
+    assert row["suppressed"] == 1
+    assert row["baselined"] == 0
+    assert row["seconds"] >= 0.0
+
+
+def test_tier_summary_lines_format():
+    from orion_tpu.analysis.__main__ import tier_summary_lines
+
+    rows = [
+        {"tier": "lint", "label": "tier A", "active": 1, "suppressed": 2,
+         "baselined": 0, "seconds": 0.125},
+        {"tier": "programs", "label": "tier E", "active": 0,
+         "suppressed": 0, "baselined": 0, "seconds": 3.5},
+    ]
+    lines = tier_summary_lines(rows)
+    assert lines[0].startswith("tier")
+    assert set(lines[1]) == {"-"}
+    assert "tier A" in lines[2] and "0.12" in lines[2]
+    assert "tier E" in lines[3] and "3.50" in lines[3]
+
+
+def test_stale_noqa_both_directions(tmp_path):
+    """A noqa that suppresses a real finding is alive; one on a clean
+    line is itself a finding. Judged from the keep-suppressed finding
+    set, comments located by TOKENIZING (docstrings that merely mention
+    the pattern are not suppressions)."""
+    from orion_tpu.analysis.staleness import (
+        RULE_STALE_NOQA,
+        stale_noqa_findings,
+    )
+
+    live = tmp_path / "orion_live.py"
+    live.write_text(
+        "def f(x=[]):  # orion: noqa[mutable-default]\n"
+        "    return x\n"
+    )
+    findings = lint_source(
+        live.read_text(), str(live), keep_suppressed=True
+    )
+    assert {f.status for f in findings} == {"suppressed"}
+    assert stale_noqa_findings(
+        findings, [str(live)], ALL_RULES.keys()
+    ) == []
+
+    stale_mod = tmp_path / "orion_stale.py"
+    stale_mod.write_text(
+        '"""mentions # orion: noqa[mutable-default] in prose only."""\n'
+        "def f(x):  # orion: noqa[mutable-default]\n"
+        "    return x\n"
+    )
+    found = stale_noqa_findings(
+        lint_source(stale_mod.read_text(), str(stale_mod),
+                    keep_suppressed=True),
+        [str(stale_mod)], ALL_RULES.keys(),
+    )
+    assert [f.rule for f in found] == [RULE_STALE_NOQA]
+    assert found[0].line == 2  # the comment, not the docstring mention
+
+
+def test_stale_noqa_scoping_rules(tmp_path):
+    """Ids of rules that did NOT run are never judged; bare noqa and
+    unknown ids are judged only on a full run."""
+    from orion_tpu.analysis.staleness import stale_noqa_findings
+
+    mod = tmp_path / "orion_scope.py"
+    mod.write_text(
+        "def f(x):  # orion: noqa[lock-order]\n"
+        "    return x\n"
+        "def g(x):  # orion: noqa\n"
+        "    return x\n"
+        "def h(x):  # orion: noqa[no-such-rule]\n"
+        "    return x\n"
+    )
+    findings = lint_source(mod.read_text(), str(mod), keep_suppressed=True)
+    # Tier A run: the Tier D id, the bare noqa, and the typo are out of scope
+    assert stale_noqa_findings(
+        findings, [str(mod)], ALL_RULES.keys()
+    ) == []
+    # full run with Tier D ids in the judging set: all three are findings
+    full = stale_noqa_findings(
+        findings, [str(mod)],
+        list(ALL_RULES.keys()) + ["lock-order"], full=True,
+    )
+    assert len(full) == 3
+
+
+def test_dead_baseline_entry_and_prune_round_trip(tmp_path, capsys):
+    """A baseline entry whose finding is fixed becomes a finding itself;
+    --prune-baseline rewrites the file keeping the live entry (and its
+    rationale) verbatim."""
+    from orion_tpu.analysis.__main__ import main
+    from orion_tpu.analysis.findings import normalize_path
+
+    mod = tmp_path / "orion_bl.py"
+    mod.write_text("def f(x=[]):\n    return x\n")
+    rel = normalize_path(str(mod))
+    bl = tmp_path / "baseline.json"
+    entries = [
+        {"rule": "mutable-default", "path": rel,
+         "reason": "fixture: grandfathered on purpose"},
+        {"rule": "bare-except", "path": rel,
+         "reason": "fixture: nothing left to grandfather"},
+    ]
+    bl.write_text(json.dumps({"entries": entries}))
+
+    # the dead entry gates...
+    rc = main([str(mod), "--tier", "lint", "--baseline", str(bl),
+               "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    by_rule = {f["rule"] for f in doc["findings"]}
+    assert "dead-baseline-entry" in by_rule
+    dead_msgs = [f["message"] for f in doc["findings"]
+                 if f["rule"] == "dead-baseline-entry"]
+    assert len(dead_msgs) == 1 and "bare-except" in dead_msgs[0]
+    assert doc["counts"]["baselined"] == 1  # the live entry still matches
+
+    # ...and --prune-baseline removes exactly it, preserving the live one
+    rc = main([str(mod), "--tier", "lint", "--baseline", str(bl),
+               "--prune-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    pruned = json.loads(bl.read_text())
+    assert pruned["entries"] == [entries[0]]
+    # idempotent: a second run is clean without touching the file again
+    assert main([str(mod), "--tier", "lint", "--baseline", str(bl)]) == 0
+
+
+def test_dead_baseline_entry_scoping():
+    """Entries are judged only when their rule ran AND their file was in
+    the audited path set — a partial run must not call baselines dead."""
+    from orion_tpu.analysis.findings import BaselineEntry as BE
+    from orion_tpu.analysis.staleness import dead_baseline_entries
+
+    entries = [
+        BE("mutable-default", "orion_tpu/a.py", "r"),
+        BE("lock-order", "orion_tpu/serving/b.py", "r"),
+    ]
+    # lint ran over orion_tpu/: the Tier D entry is out of judging scope
+    dead = dead_baseline_entries(
+        [], entries, ALL_RULES.keys(), ["orion_tpu"]
+    )
+    assert dead == [entries[0]]
+    # path outside the audited prefixes is never judged
+    dead = dead_baseline_entries(
+        [], entries, ALL_RULES.keys(), ["orion_tpu/serving"]
+    )
+    assert dead == []

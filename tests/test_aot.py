@@ -260,3 +260,164 @@ def test_topology_aot_sp_fused_ce():
     assert rep["compiled"]
     cc = rep["collectives"]
     assert cc["mosaic_kernels"] > 0, cc
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: decode_plan vs the DECLARED universe (analysis/programs.py)
+# ---------------------------------------------------------------------------
+
+
+def _fp_kwargs(fp):
+    return {k: v for k, v in fp.items() if k != "expect_programs"}
+
+
+def test_decode_plan_pure_inventory_matches_declared_universe():
+    """``lower=False`` returns the identity-only inventory — no jax work
+    at all — and it equals the universe computed from the declarations,
+    for every pinned check footprint."""
+    from orion_tpu.aot import decode_plan, verify_decode_plan
+    from orion_tpu.analysis import programs as P
+
+    cfg = get_config("tiny")
+    for fp in P.CHECK_FOOTPRINTS:
+        rep = decode_plan(cfg, compile_step=False, lower=False,
+                          **_fp_kwargs(fp))
+        assert len(rep["programs"]) == fp["expect_programs"]
+        assert not any("lowered" in p for p in rep["programs"])
+        assert verify_decode_plan(rep) == []
+        expected = P.expected_decode_universe(**_fp_kwargs(fp))
+        assert (
+            {tuple(sorted(p.items())) for p in rep["programs"]}
+            == {tuple(sorted(e.items())) for e in expected}
+        ), (rep["programs"], expected)
+
+
+def test_decode_cli_verify_gate_for_check_footprints(capsys):
+    """Acceptance: ``aot --decode --verify`` passes (exit 0, every
+    program lowered, verified flag set). The CLI lowers one footprint
+    end-to-end; both footprints' universe equality is covered lower-free
+    by test_decode_plan_pure_inventory_matches_declared_universe."""
+    import json
+
+    from orion_tpu.aot import main as aot_main
+    from orion_tpu.analysis import programs as P
+
+    for fp in P.CHECK_FOOTPRINTS[:1]:
+        argv = [
+            "--config", "tiny", "--decode", "--lower-only", "--verify",
+            "--slots", str(fp["slots"]), "--chunk", str(fp["chunk"]),
+            "--prefill-buckets",
+            ",".join(str(b) for b in fp["prefill_buckets"]),
+            "--prefill-chunk", str(fp["prefill_chunk"]),
+            "--qmode", fp["qmode"], "--spec-depth", str(fp["spec_depth"]),
+        ]
+        rc = aot_main(argv)
+        out = capsys.readouterr()
+        assert rc == 0, out.err
+        doc = json.loads(out.out)
+        assert doc["verified"] is True
+        assert len(doc["programs"]) == fp["expect_programs"]
+        assert all(p.get("lowered") for p in doc["programs"]), doc
+
+
+def test_verify_decode_plan_reports_drift():
+    """Doctored reports drift in every direction verify must catch."""
+    from orion_tpu.aot import decode_plan, verify_decode_plan
+    from orion_tpu.analysis import programs as P
+
+    cfg = get_config("tiny")
+    fp = _fp_kwargs(P.CHECK_FOOTPRINTS[1])
+    rep = decode_plan(cfg, compile_step=False, lower=False, **fp)
+
+    dropped = dict(rep, programs=rep["programs"][:-1])
+    assert any("missing from plan" in m
+               for m in verify_decode_plan(dropped))
+
+    phantom = dict(rep, programs=rep["programs"] + [
+        {"kind": "phantom_warmup", "slots": fp["slots"], "qmode": "off",
+         "tp": 1}
+    ])
+    assert any("not in declared universe" in m
+               for m in verify_decode_plan(phantom))
+
+    broken = dict(rep, programs=[
+        dict(rep["programs"][0], error="lowering exploded")
+    ])
+    assert any("fails to lower" in m for m in verify_decode_plan(broken))
+
+
+def test_engine_lifetime_compile_count_matches_plan_prediction():
+    """Acceptance: a replica's MEASURED lifetime compile count equals the
+    plan's prediction — cache-stat deltas on the real jit wrappers while
+    a fresh engine serves prompts touching every declared bucket (with a
+    repeat hit proving bucket reuse does not recompile, and the plain
+    prefill wrapper proving its plan=\"never\" declaration)."""
+    from collections import Counter
+
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.aot import decode_plan
+    from orion_tpu.analysis import programs as P
+    from orion_tpu.generate import (
+        SampleConfig,
+        _decode_batched_chunk_jit,
+        _prefill_carry_bucketed_jit,
+        _prefill_carry_jit,
+    )
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.serving import DecodeRequest
+    from orion_tpu.serving.batching import SlotEngine
+
+    # the smallest model that exercises the real wrappers: cache COUNTS
+    # are what's asserted, so one linear layer keeps the five compiles
+    # this test pays as cheap as they get
+    cfg = ModelConfig(
+        name="aot_engine_test", vocab_size=32, d_model=16, n_layers=1,
+        n_heads=2, layer_types=("linear",), window=4,
+        max_seq_len=64, dtype="float32", backend="xla",
+    )
+    greedy = SampleConfig(temperature=0.0)
+
+    for fp in P.CHECK_FOOTPRINTS:
+        plan_kinds = Counter(
+            p["kind"] for p in decode_plan(
+                cfg, compile_step=False, lower=False, **_fp_kwargs(fp)
+            )["programs"]
+        )
+        # the jit static key on the model is STRUCTURAL (config value,
+        # not instance identity) — a per-footprint config name keeps the
+        # global cache deltas attributable to THIS engine
+        model = TransformerLM(dataclasses.replace(
+            cfg, name=f"aot_engine_{fp['slots']}x{fp['chunk']}"
+        ))
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+        before = {
+            "decode_batched": _decode_batched_chunk_jit._cache_size(),
+            "prefill_bucketed": _prefill_carry_bucketed_jit._cache_size(),
+            "prefill": _prefill_carry_jit._cache_size(),
+        }
+        eng = SlotEngine(
+            model, params, slots=fp["slots"], chunk=fp["chunk"],
+            prefill_buckets=fp["prefill_buckets"],
+        )
+        lengths = [b - 3 for b in fp["prefill_buckets"]]
+        lengths.append(fp["prefill_buckets"][-1] - 1)  # bucket reuse
+        for i, ln in enumerate(lengths):
+            prompt = jax.random.randint(
+                jax.random.PRNGKey(7000 + i), (1, ln), 0, cfg.vocab_size
+            ).astype(jnp.int32)
+            eng.admit(DecodeRequest(prompt=prompt, max_new_tokens=6,
+                                    sample=greedy, seed=i))
+        while eng.busy:
+            eng.step()
+        measured = Counter({
+            "decode_batched": _decode_batched_chunk_jit._cache_size()
+            - before["decode_batched"],
+            "prefill_bucketed": _prefill_carry_bucketed_jit._cache_size()
+            - before["prefill_bucketed"],
+            "prefill": _prefill_carry_jit._cache_size()
+            - before["prefill"],
+        })
+        assert measured == plan_kinds, (fp, measured, plan_kinds)
