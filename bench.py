@@ -1836,6 +1836,409 @@ def bench_fleet(
     return out
 
 
+# -- millisecond replicas: AOT exec store + elastic fleet (ISSUE 20) ----------
+
+
+def bench_cold_start(
+    n_layers: int = 12,
+    d_model: int = 384,
+    slots: int = 8,
+    chunk: int = 16,
+    prefill_chunk: int = 4,
+    bucket: int = 12,
+    prompt_len: int = 8,
+    max_new: int = 17,
+) -> dict:
+    """Spawn-to-first-reply of a real child-process replica, compile-cold
+    vs AOT-warm (serving/exec_store.py). Both children get a FRESH XLA
+    persistent-cache dir (``jax_flags``) so neither inherits compiles
+    from this process or a previous run: the cold child pays every
+    decode-plan compile in-process, the warm child downloads serialized
+    executables published by an in-parent :func:`orion_tpu.aot.warm`
+    pass — which itself runs against a fresh cache dir so the published
+    compile cost is honest too.
+
+    The row carries TWO ratios. ``total_speedup`` is end-to-end
+    spawn→first-reply — on CPU it plateaus around 3x because the warm
+    floor is interpreter+jax boot, model init, and the engine's small
+    UNdeclared helper jits (slot flags, prompt staging), none of which
+    the store addresses. ``program_acquisition.speedup`` isolates what
+    the store actually replaces — acquiring the decode-plan executables
+    by compiling+publishing vs deserializing them back out — and is the
+    >=5x acceptance figure (typically 20-50x; the gap to total is the
+    fixed boot floor, not store overhead).
+
+    Identity parity is the part a deployment must get right and the
+    bench exercises deliberately: the store is keyed with the SAME
+    ``params_id`` the child derives via ``fleet.replica.build_model``
+    (config+overrides+seed) — keying it with the aot CLI's default
+    cfg-hash identity would silently never hit. Cross-checks: the
+    published entry count equals the DECLARED compile universe
+    (``analysis.programs.expected_decode_universe``) and the warm child
+    reports zero fallback compiles over its served request."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from orion_tpu import aot
+    from orion_tpu.analysis.programs import expected_decode_universe
+    from orion_tpu.fleet import ProcessReplica, ReplicaSpec
+    from orion_tpu.fleet.replica import build_model
+    from orion_tpu.generate import SampleConfig
+    from orion_tpu.obs.metrics import snapshot_value
+    from orion_tpu.serving import DecodeRequest
+    from orion_tpu.serving.exec_store import ExecStore
+
+    overrides = {"n_layers": n_layers, "d_model": d_model}
+    serve = {
+        "slots": slots, "chunk": chunk, "prefill_chunk": prefill_chunk,
+        "prefill_buckets": str(bucket), "max_inflight": slots,
+        # capacity/ledger surfaces lower+price programs at startup —
+        # real warm-start deployments defer them; here they would blur
+        # the program-acquisition split the row exists to measure
+        "cost": False, "cost_ledger": False,
+    }
+    root = tempfile.mkdtemp(prefix="orion-coldstart-")
+    exec_dir = os.path.join(root, "exec")
+    clock = time.monotonic
+
+    def spawn_first_reply(tag, extra_serve=None):
+        spec = ReplicaSpec(
+            config="tiny", overrides=dict(overrides),
+            serve=dict(serve, **(extra_serve or {})),
+            jax_flags={"jax_compilation_cache_dir":
+                       os.path.join(root, f"xla-{tag}")},
+        )
+        t0 = clock()
+        rep = ProcessReplica(spec, name=f"{tag}-0.g0").start()
+        try:
+            rep.wait_ready(timeout=300.0)
+            ready_s = clock() - t0
+            pend = rep.submit(DecodeRequest(
+                prompt=np.ones((1, prompt_len), np.int32),
+                max_new_tokens=max_new, sample=SampleConfig(), seed=0,
+            ))
+            pend.done.wait(timeout=600.0)
+            first_s = clock() - t0
+            ok = pend.result is not None and pend.result.status == "ok"
+            status = rep.status(timeout=10.0) or {}
+        finally:
+            rep.kill()
+            rep.join(timeout=10.0)
+        return {
+            "spawn_to_ready_s": round(ready_s, 3),
+            "spawn_to_first_reply_s": round(first_s, 3),
+            "serve_part_s": round(first_s - ready_s, 3),
+            "ok": ok,
+        }, status
+
+    try:
+        cold, _ = spawn_first_reply("cold")
+
+        # publish pass: compile the declared universe into the store
+        # under the CHILD's weights identity (build_model's params_id —
+        # parity is the whole game, see docstring), against a fresh XLA
+        # cache dir so publish_wall_s is a true compile cost
+        import jax
+
+        spec0 = ReplicaSpec(config="tiny", overrides=dict(overrides))
+        model, _params, params_id = build_model(spec0)
+        store = ExecStore(
+            exec_dir, identity=f"{params_id}|off",
+            local_dir=os.path.join(root, "exec-local-pub"),
+        )
+        prev_cache = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(root, "xla-pub"))
+        t0 = clock()
+        try:
+            report = aot.warm(
+                model.cfg, store, slots=slots, chunk=chunk,
+                prefill_buckets=(bucket,), prefill_chunk=prefill_chunk,
+            )
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev_cache)
+        publish_s = clock() - t0
+
+        universe = expected_decode_universe(
+            slots=report["slots"], chunk=report["chunk"],
+            prefill_buckets=tuple(report["prefill_buckets"]),
+            prefill_chunk=report["prefill_chunk_aligned"],
+            qmode=report["qmode"], tp=report["tp"],
+            spec_depth=report.get("spec_depth", 0),
+        )
+        entries = store.entries()
+
+        # acquisition-by-load: a second consumer (fresh resident LRU +
+        # fresh local tier, same shared dir) deserializes the whole
+        # universe — the store-side half of the >=5x ratio
+        loader = ExecStore(
+            exec_dir, identity=f"{params_id}|off",
+            local_dir=os.path.join(root, "exec-local-load"),
+        )
+        docs = loader.entries()
+        t0 = clock()
+        loaded = [loader.lookup(d["ident"], d.get("sample", ""))
+                  for d in docs]
+        load_s = clock() - t0
+
+        warm, warm_status = spawn_first_reply("warm", extra_serve={
+            "exec_dir": exec_dir,
+            "exec_local_dir": os.path.join(root, "exec-local-child"),
+        })
+        m = warm_status.get("metrics") or {}
+        hits = snapshot_value(m, "exec_store_events", {"event": "hits"})
+        fallbacks = snapshot_value(
+            m, "exec_store_events", {"event": "fallback_compiles"})
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    total = (cold["spawn_to_first_reply_s"]
+             / max(warm["spawn_to_first_reply_s"], 1e-9))
+    acq = publish_s / max(load_s, 1e-9)
+    return {
+        "config": "tiny", "overrides": overrides,
+        "footprint": {"slots": slots, "chunk": chunk,
+                      "prefill_buckets": [bucket],
+                      "prefill_chunk": prefill_chunk, "qmode": "off"},
+        "prompt_len": prompt_len, "max_new_tokens": max_new,
+        "cold": cold, "warm": warm,
+        "total_speedup": round(total, 2),
+        "program_acquisition": {
+            "compile_publish_s": round(publish_s, 3),
+            "store_load_s": round(load_s, 3),
+            "speedup": round(acq, 1),
+            "all_loaded": all(x is not None for x in loaded),
+        },
+        "store_entries": len(entries),
+        "universe_expected": len(universe),
+        "universe_match": len(entries) == len(universe),
+        "warm_child": {
+            "exec_hits": hits, "fallback_compiles": fallbacks,
+            "zero_fallback_compiles": fallbacks == 0,
+        },
+        "note": (
+            "total_speedup is bounded by the warm floor (child "
+            "interpreter+jax boot, model init, undeclared helper jits) "
+            "that AOT executables cannot address on CPU; "
+            "program_acquisition isolates compile-vs-deserialize for "
+            "the declared universe and is the >=5x acceptance figure"
+        ),
+    }
+
+
+def bench_elastic(
+    slots: int = 4,
+    chunk: int = 4,
+    n_sessions: int = 6,
+    prompt_len: int = 6,
+    turn_new: int = 12,
+    burst: int = 16,
+    burst_new: int = 48,
+) -> dict:
+    """Elastic warm-start autoscaling (fleet/supervisor.py): a
+    step-function load doubling against a 1-replica fleet must trigger a
+    queue-pressure scale-out BEFORE any replica's fast-burn SLO page
+    fires; going idle must scale back in with ZERO lost conversation
+    turns (the victim's resident sessions suspend to the shared session
+    store and resume on the survivors); and a mid-conversation footprint
+    morph (tp 1 -> 2) must be bitwise-invisible in the tokens (the
+    ISSUE 14 pinned tp-flip — qmode flips change the weights identity
+    and are spelled as a new fleet, never a morph).
+
+    LocalReplica transport: the elasticity under test is the control
+    loop (signals, hysteresis, router add/remove, drain), not process
+    spawn cost — that is the cold_start row. In-thread replicas share
+    this process's jit caches, so the scale-out spawn itself is
+    milliseconds and the measured latency is pure control-loop
+    (up_ticks x tick cadence). Capacity surfaces stay off so the
+    LEADING queue-depth signal governs deterministically."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.fleet import LocalReplica, Supervisor
+    from orion_tpu.fleet.supervisor import AutoscalePolicy
+    from orion_tpu.generate import SampleConfig
+    from orion_tpu.models.configs import get_config
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.serving import DecodeRequest, ServeConfig
+
+    cfg = get_config("tiny")
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    root = tempfile.mkdtemp(prefix="orion-elastic-")
+    sess_dir = os.path.join(root, "sessions")
+    clock = time.monotonic
+    greedy = SampleConfig(temperature=0.0)
+    tp_devices = len(jax.devices())
+
+    def factory_tp(tp):
+        def make(name):
+            scfg = ServeConfig(
+                slots=slots, chunk=chunk, session_dir=sess_dir,
+                max_inflight=4 * burst, cost=False, cost_ledger=False,
+                tp=tp,
+            )
+            return LocalReplica(model, params, scfg, name=name).start()
+        return make
+
+    def run_turn(router, sid, tokens, new):
+        pend = router.submit(DecodeRequest(
+            prompt=np.asarray(tokens, np.int32)[None, :],
+            max_new_tokens=new, sample=greedy, seed=0, session_id=sid,
+        ))
+        pend.done.wait(timeout=300.0)
+        res = pend.result
+        toks = (np.asarray(res.tokens).ravel().tolist()
+                if res is not None and res.status == "ok" else None)
+        return (res.status if res is not None else "lost"), toks
+
+    turn_prompts = [
+        list(range(1, 1 + prompt_len)), [7, 9], [11, 13],
+    ]
+
+    def conversation(router, sid):
+        out = []
+        for t, toks in enumerate(turn_prompts):
+            status, got = run_turn(router, sid, toks, turn_new)
+            out.append((status, got))
+        return out
+
+    # bitwise reference: the same 3-turn conversations on one unmorphed
+    # replica with a private session store — what the fleet must match
+    # through scale-out, scale-in, AND the tp morph
+    ref = LocalReplica(
+        model, params,
+        ServeConfig(slots=slots, chunk=chunk,
+                    session_dir=os.path.join(root, "ref-sessions"),
+                    max_inflight=4 * burst, cost=False, cost_ledger=False),
+        name="ref-0.g0",
+    ).start()
+    try:
+        reference = {
+            f"s{i}": conversation(ref, f"s{i}") for i in range(n_sessions)
+        }
+    finally:
+        ref.drain()
+        ref.join(timeout=60.0)
+
+    pol = AutoscalePolicy(
+        min_replicas=1, max_replicas=3,
+        queue_high=float(slots), queue_low=1.0,
+        up_ticks=2, down_ticks=3, cooldown_ticks=2,
+    )
+    sup = Supervisor(
+        factory_tp(1), 1, max_inflight=8 * burst, autoscale=pol,
+    ).start()
+    events_t0 = clock()
+    try:
+        # -- phase 1: step-function burst against the 1-replica fleet --
+        pendings = [sup.router.submit(DecodeRequest(
+            prompt=np.ones((1, prompt_len), np.int32),
+            max_new_tokens=burst_new, sample=greedy, seed=i,
+        )) for i in range(burst)]
+        scale_out_s = fast_burn_s = None
+        scale_out_why = None
+        while clock() - events_t0 < 120.0:
+            sup.tick()
+            if fast_burn_s is None and any(
+                bool(((getattr(r, "last_status", None) or {})
+                      .get("slo") or {}).get("firing_fast"))
+                for r in sup.replicas
+            ):
+                fast_burn_s = clock() - events_t0
+            hit = [e for e in sup.events if "scale_out" in e[2]]
+            if hit:
+                scale_out_s = clock() - events_t0
+                scale_out_why = hit[0][2]
+                break
+            time.sleep(0.05)
+        for p in pendings:
+            p.done.wait(timeout=300.0)
+        burst_ok = sum(
+            1 for p in pendings
+            if p.result is not None and p.result.status == "ok"
+        )
+
+        # -- phase 2: conversations turn 1-2, then idle -> scale-in ----
+        turns = {f"s{i}": [] for i in range(n_sessions)}
+        for sid in turns:
+            turns[sid].append(run_turn(sup.router, sid,
+                                       turn_prompts[0], turn_new))
+        scale_in = False
+        for _ in range(60):
+            sup.tick()
+            if any("scale_in" in e[2] for e in sup.events):
+                scale_in = True
+                break
+            time.sleep(0.02)
+        replicas_after_in = len(sup.router.replicas)
+        for sid in turns:  # resumed from the shared store post-drain
+            turns[sid].append(run_turn(sup.router, sid,
+                                       turn_prompts[1], turn_new))
+
+        # -- phase 3: mid-conversation footprint morph (tp flip) -------
+        morph_tp = 2 if tp_devices >= 2 else 1
+        sup.morph(factory_tp(morph_tp), why="tp-flip")
+        for sid in turns:
+            turns[sid].append(run_turn(sup.router, sid,
+                                       turn_prompts[2], turn_new))
+        events = [
+            (round(t - events_t0, 3), name, what)
+            for t, name, what in sup.events
+        ]
+        signals = sup.autoscale_state()
+    finally:
+        sup.drain_all(timeout=120.0)
+        shutil.rmtree(root, ignore_errors=True)
+
+    lost = sum(
+        1 for tlist in turns.values() for status, _ in tlist
+        if status != "ok"
+    )
+    bitwise = all(
+        turns[sid][t][1] == reference[sid][t][1]
+        for sid in turns for t in range(len(turn_prompts))
+    )
+    return {
+        "config": "tiny", "slots": slots, "chunk": chunk,
+        "burst_requests": burst, "burst_completed": burst_ok,
+        "policy": dataclasses.asdict(pol),
+        "scale_out": {
+            "happened": scale_out_s is not None,
+            "s_after_step": (round(scale_out_s, 3)
+                             if scale_out_s is not None else None),
+            "why": scale_out_why,
+            "fast_burn_page_s": (round(fast_burn_s, 3)
+                                 if fast_burn_s is not None else None),
+            "before_fast_burn_page": (
+                scale_out_s is not None
+                and (fast_burn_s is None or scale_out_s < fast_burn_s)
+            ),
+        },
+        "scale_in": {
+            "happened": scale_in,
+            "replicas_after": replicas_after_in,
+            "lost_turns": lost,
+        },
+        "morph": {
+            "tp_from": 1, "tp_to": morph_tp,
+            "sessions": n_sessions,
+            "bitwise_identical_vs_unmorphed": bitwise,
+        },
+        "events": events,
+        "autoscale_signals": signals,
+    }
+
+
 # -- adversarial trace: one long prompt among shorts (ISSUE 7) ----------------
 
 
@@ -2588,9 +2991,31 @@ def main(argv=None) -> int:
                          "the zero-failed/zero-shed contract, and update "
                          "the 'store_outage' row of BENCH_SERVE.json in "
                          "place")
+    ap.add_argument("--cold-start", action="store_true",
+                    help="millisecond-replica bench: spawn->first-reply of "
+                         "a child replica compile-cold vs AOT-warm from "
+                         "the exec store, with the program-acquisition "
+                         "(compile vs deserialize) split and the "
+                         "declared-universe cross-check; updates the "
+                         "'cold_start' row of BENCH_SERVE.json in place")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic-autoscaler bench: step-function load "
+                         "doubling must scale out before a fast-burn "
+                         "page, idle must scale in with zero lost "
+                         "session turns, and a mid-conversation tp "
+                         "morph must be bitwise-invisible; updates the "
+                         "'elastic' row of BENCH_SERVE.json in place")
     ap.add_argument("--remat-sweep", action="store_true",
                     help="policy x skip operating-point sweep (VERDICT r4)")
     args = ap.parse_args(argv)
+
+    if args.elastic:
+        # the morph leg flips the fleet to a tp=2 footprint in-process;
+        # the 2-virtual-device world must be provisioned before the
+        # parent's backend initializes (same ordering note as --serve-tp)
+        from orion_tpu.utils.devices import ensure_virtual_devices
+
+        ensure_virtual_devices(2)
 
     if args.serve_tp:
         # the tp row needs the 8-virtual-CPU-device world; the flag is
@@ -2630,6 +3055,39 @@ def main(argv=None) -> int:
                 "scaling_efficiency_vs_ceiling"),
             "router_p50_overhead_1replica": res.get(
                 "router_p50_overhead_1replica"),
+        }))
+        return 0
+
+    if args.cold_start:
+        res = bench_cold_start()
+        _update_bench_serve_row("cold_start", res)
+        print(json.dumps({
+            "metric": "serve_cold_start_aot_warm",
+            "cold_spawn_to_first_reply_s":
+                res["cold"]["spawn_to_first_reply_s"],
+            "warm_spawn_to_first_reply_s":
+                res["warm"]["spawn_to_first_reply_s"],
+            "total_speedup": res["total_speedup"],
+            "program_acquisition_speedup":
+                res["program_acquisition"]["speedup"],
+            "universe_match": res["universe_match"],
+            "zero_fallback_compiles":
+                res["warm_child"]["zero_fallback_compiles"],
+        }))
+        return 0
+
+    if args.elastic:
+        res = bench_elastic()
+        _update_bench_serve_row("elastic", res)
+        print(json.dumps({
+            "metric": "serve_elastic_autoscale",
+            "scale_out_s_after_step": res["scale_out"]["s_after_step"],
+            "scale_out_before_fast_burn_page":
+                res["scale_out"]["before_fast_burn_page"],
+            "scale_in": res["scale_in"]["happened"],
+            "lost_turns": res["scale_in"]["lost_turns"],
+            "morph_bitwise_identical":
+                res["morph"]["bitwise_identical_vs_unmorphed"],
         }))
         return 0
 

@@ -116,7 +116,7 @@ class NonAtomicPersistRule:
             )
 
 
-_STORE_MODULES = ("session_store.py", "prefix_store.py")
+_STORE_MODULES = ("session_store.py", "prefix_store.py", "exec_store.py")
 
 # The syscalls the stores actually issue on their hot paths. os.makedirs at
 # construction time is deliberately not listed: it runs once, before the

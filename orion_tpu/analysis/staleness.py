@@ -16,6 +16,11 @@ dead ones:
 - **dead-baseline-entry** — a baseline entry whose rule ran over its
   file and produced nothing. ``--prune-baseline`` rewrites the baseline
   minus the dead entries, preserving the rationales of the live ones.
+- **dead-exec-entry** (ISSUE 20) — a serialized executable in an AOT
+  exec store whose ProgramDecl no longer exists (or drifted): content
+  addressing means the live universe hashes to different keys, so the
+  entry is unreachable forever. ``python -m orion_tpu.serving.exec_store
+  gc`` prunes them.
 
 Suppression comments are found by TOKENIZING, not by regexing raw lines:
 the noqa pattern appears inside docstrings and string literals all over
@@ -44,8 +49,11 @@ from orion_tpu.analysis.lint import (
 
 RULE_STALE_NOQA = "stale-noqa"
 RULE_DEAD_BASELINE = "dead-baseline-entry"
+RULE_DEAD_EXEC = "dead-exec-entry"
 
-ALL_STALENESS_CHECKS = (RULE_STALE_NOQA, RULE_DEAD_BASELINE)
+ALL_STALENESS_CHECKS = (
+    RULE_STALE_NOQA, RULE_DEAD_BASELINE, RULE_DEAD_EXEC,
+)
 
 
 def _noqa_comments(source: str) -> List[Tuple[int, FrozenSet[str]]]:
@@ -182,6 +190,58 @@ def dead_baseline_findings(
     ]
 
 
+def dead_exec_entries(entries: Sequence[dict]) -> List[dict]:
+    """Manifests from an exec store (``ExecStore.entries()``) that
+    nothing in the DECLARED compile universe can ever address again
+    (ISSUE 20 satellite): the kind is no longer a decode-section
+    ProgramDecl, or the kind's declaration drifted since publication
+    (``decl_fingerprint`` is part of the content address, so the live
+    universe now hashes to a different key and this entry is
+    unreachable disk forever). Same decay principle as a dead baseline
+    entry — an address nothing resolves to is storage wired to
+    nothing. Prunable via ``python -m orion_tpu.serving.exec_store
+    gc``."""
+    from orion_tpu.serving.exec_store import decl_fingerprint
+
+    out = []
+    for doc in entries:
+        kind = str((doc.get("ident") or {}).get("kind", ""))
+        current = decl_fingerprint(kind)
+        if current.startswith("undeclared:") or doc.get("decl") != current:
+            out.append(doc)
+    return out
+
+
+def dead_exec_findings(
+    dead: Sequence[dict], store_dir: str, root: str = ""
+) -> List[Finding]:
+    rel = normalize_path(store_dir, root)
+    out = []
+    for doc in dead:
+        kind = str((doc.get("ident") or {}).get("kind", ""))
+        current_gone = decl_exists = False
+        try:
+            from orion_tpu.analysis.programs import PROGRAMS
+
+            decl_exists = any(
+                d.name == kind and d.section == "decode" for d in PROGRAMS
+            )
+        except Exception:
+            pass
+        current_gone = not decl_exists
+        out.append(Finding(
+            RULE_DEAD_EXEC, rel, 0,
+            f"exec store entry `{doc.get('key')}` (kind `{kind}`) is "
+            + ("for a kind no decode ProgramDecl declares"
+               if current_gone else
+               "addressed under a SUPERSEDED declaration of its kind — "
+               "the live universe hashes to a different key")
+            + "; nothing can ever hit it again. Prune with `python -m "
+            "orion_tpu.serving.exec_store gc`",
+        ))
+    return out
+
+
 def prune_baseline(
     baseline_path: str, dead: Sequence[BaselineEntry]
 ) -> int:
@@ -207,7 +267,8 @@ def prune_baseline(
 
 
 __all__ = [
-    "ALL_STALENESS_CHECKS", "RULE_DEAD_BASELINE", "RULE_STALE_NOQA",
-    "dead_baseline_entries", "dead_baseline_findings", "prune_baseline",
+    "ALL_STALENESS_CHECKS", "RULE_DEAD_BASELINE", "RULE_DEAD_EXEC",
+    "RULE_STALE_NOQA", "dead_baseline_entries", "dead_baseline_findings",
+    "dead_exec_entries", "dead_exec_findings", "prune_baseline",
     "stale_noqa_findings",
 ]

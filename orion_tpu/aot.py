@@ -338,6 +338,8 @@ def decode_plan(
     spec_depth: int = 0,
     compile_step: bool = True,
     lower: bool = True,
+    store=None,
+    sample=None,
 ) -> Dict[str, Any]:
     """The SERVING-side inventory ``plan`` never had (ISSUE 14): every
     decode/prefill executable a replica of this shape compiles, keyed
@@ -354,7 +356,22 @@ def decode_plan(
     key and store. ``lower=False`` skips lowering entirely and returns
     the pure inventory (identity keys only) — the cheap side Tier E's
     plan-drift rule and :func:`verify_decode_plan` diff against the
-    declared universe."""
+    declared universe.
+
+    ``store`` (a :class:`~orion_tpu.serving.exec_store.ExecStore`)
+    engages the warm-start path both ways: a program whose identity is
+    already COMMITTED in the store short-circuits (``warm: True`` on its
+    entry, no lowering — repeated ``--verify`` preflights cost one
+    listdir per program), and a freshly compiled program is serialized
+    and PUBLISHED (``published_gen`` on the entry; per-entry
+    ``publish_error`` on failure, never raised — the plan must come out
+    even when the store is down).
+
+    ``sample`` is the SampleConfig the programs are specialized on (a
+    jit static, part of every executable's content address — the CLIs
+    default temperature 0.8, NOT the dataclass default 1.0, so a warm
+    meant for CLI-launched replicas must be published under the same
+    sampling statics). None = dataclass defaults."""
     tp = max(int(tp), 1)
     base_key = {"slots": slots, "chunk": chunk, "qmode": qmode, "tp": tp}
 
@@ -446,14 +463,30 @@ def decode_plan(
             "model": model, "params": params, "carry": carry,
             "rngs": rngs, "active": active, "shaped": shaped,
             "vec": lambda dt: shaped((slots,), dt),
-            "sample": SampleConfig(),
+            "sample": sample if sample is not None else SampleConfig(),
             "i32": jnp.int32, "u32": jnp.uint32, "bool": jnp.bool_,
             "decode_batched": _decode_batched_chunk_jit,
             "unified_prefill": _decode_batched_prefill_chunk_jit,
             "prefill_bucketed": _prefill_carry_bucketed_jit,
             "spec_round": _decode_batched_spec_round_jit,
         }
+        sample_fp = ""
+        if store is not None:
+            from orion_tpu.serving.exec_store import sample_fingerprint
+
+            sample_fp = sample_fingerprint(env["sample"])
         for entry, thunk in jobs:
+            ident = dict(entry)  # pure identity until this pass mutates it
+            if store is not None and store.has(ident, sample_fp):
+                # content-hash short-circuit: a COMMITTED executable is
+                # the proof this program lowers and compiles — repeated
+                # preflights (bench.py runs --verify before real work)
+                # cost one listdir per program instead of a lowering
+                entry["warm"] = True
+                entry["lowered"] = True
+                if compile_step:
+                    entry["compiled"] = True
+                continue
             try:
                 lowered = thunk(env)
                 entry["lowered"] = True
@@ -467,6 +500,17 @@ def decode_plan(
                 if compile_step:
                     compiled = lowered.compile()
                     entry["compiled"] = True
+                    if store is not None:
+                        try:
+                            entry["published_gen"] = store.publish(
+                                ident, compiled, sample_fp
+                            )
+                        except Exception as e:
+                            # the plan must come out even when the store
+                            # is down; warm() surfaces these per-entry
+                            entry["publish_error"] = (
+                                f"{type(e).__name__}: {e}"[:200]
+                            )
                     try:
                         entry["collectives"] = _collective_counts(
                             compiled.as_text()
@@ -503,6 +547,46 @@ def decode_plan(
     }
 
 
+def warm(
+    model_cfg,
+    store,
+    slots: int = 8,
+    chunk: int = 16,
+    prefill_buckets=(),
+    prefill_chunk: int = 0,
+    qmode: str = "off",
+    tp: int = 0,
+    spec_depth: int = 0,
+    sample=None,
+) -> Dict[str, Any]:
+    """Serialize the whole :func:`decode_plan` universe of one footprint
+    into ``store`` (ROADMAP item 1's publish half): compile every
+    program a replica of this shape runs and publish each executable
+    under its content address. Idempotent and cheap to re-run — a
+    program already committed short-circuits on the content hash
+    without lowering. Returns the plan report with warm-path summary
+    fields (``warmed`` fresh publishes, ``already_warm``
+    short-circuits, ``publish_errors``). ``sample`` must be the
+    SampleConfig replicas will serve with (see :func:`decode_plan`) —
+    a warm under the wrong sampling statics publishes executables no
+    lookup ever addresses."""
+    report = decode_plan(
+        model_cfg, slots=slots, chunk=chunk,
+        prefill_buckets=prefill_buckets, prefill_chunk=prefill_chunk,
+        qmode=qmode, tp=tp, spec_depth=spec_depth,
+        compile_step=True, store=store, sample=sample,
+    )
+    progs = report.get("programs", ())
+    report["warmed"] = sum(
+        1 for p in progs if p.get("published_gen") is not None
+    )
+    report["already_warm"] = sum(1 for p in progs if p.get("warm"))
+    report["publish_errors"] = [
+        p["publish_error"] for p in progs if p.get("publish_error")
+    ]
+    return report
+
+
 def verify_decode_plan(report: Dict[str, Any]) -> list:
     """Diff a :func:`decode_plan` report against the DECLARED universe
     (``analysis/programs.py`` — ``expected_decode_universe`` reproduces
@@ -537,6 +621,10 @@ def verify_decode_plan(report: Dict[str, Any]) -> list:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("orion_tpu.aot")
+    p.add_argument("cmd", nargs="?", choices=["warm"], default=None,
+                   help="warm: compile the --decode universe and publish "
+                        "every executable into --exec-dir (implies "
+                        "--decode); default: report only")
     p.add_argument("--config", default="hybrid_7b")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=None,
@@ -577,7 +665,32 @@ def main(argv=None) -> int:
                    help="with --decode: assert the plan inventory exactly "
                         "matches the declared program universe "
                         "(analysis/programs.py) — exit 1 on drift")
+    p.add_argument("--exec-dir", default="",
+                   help="AOT executable store root (serving/exec_store.py): "
+                        "`warm` publishes into it; --decode/--verify "
+                        "short-circuit per-program on a committed entry")
+    p.add_argument("--params-id", default="",
+                   help="weights identity for the executable store "
+                        "(default: '<config>:ov=<overrides-hash>:seed=0', "
+                        "exactly what the serving/fleet CLIs derive for "
+                        "seeded-init params — pin it to the CLI-printed id "
+                        "when serving a real checkpoint)")
+    p.add_argument("--temperature", type=float, default=0.8,
+                   help="sampling statics the executables are specialized "
+                        "on (jit statics, part of the content address) — "
+                        "defaults MATCH the serving/fleet CLI defaults, "
+                        "not the SampleConfig dataclass defaults")
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--eos", type=int, default=-1,
+                   help="eos token id baked into the sampling statics "
+                        "(-1 = none, the CLI default without --tokenizer "
+                        "--eos)")
     args = p.parse_args(argv)
+    if args.cmd == "warm":
+        if not args.exec_dir:
+            p.error("warm requires --exec-dir")
+        args.decode = True
 
     if args.topology:
         # the topology client compiles for the named TPU target; the DEFAULT
@@ -607,8 +720,27 @@ def main(argv=None) -> int:
     if args.decode:
         from orion_tpu.serving.batching import parse_buckets
 
-        report = decode_plan(
-            model,
+        store = None
+        if args.exec_dir:
+            # identity must match what a CLI-launched Server derives
+            # EXACTLY (params_id|qmode) or warm entries can never hit
+            # at serving time. Both serving CLIs always pass an explicit
+            # '<config>:ov=<fp>:seed=<seed>' (or ':ckpt=...:step=...')
+            # params_id — the config-hash params_identity fallback in
+            # Server only applies to embedded use, so default to the
+            # CLI-shaped seeded-init id here
+            from orion_tpu.serving.exec_store import ExecStore
+            from orion_tpu.serving.prefix_store import overrides_fingerprint
+            from orion_tpu.utils.config import parse_set_overrides as _pso
+
+            ov = overrides_fingerprint(_pso(args.set) if args.set else {})
+            pid = args.params_id or f"{args.config}:ov={ov}:seed=0"
+            store = ExecStore(
+                args.exec_dir, identity=f"{pid}|{args.qmode}"
+            )
+        from orion_tpu.generate import SampleConfig
+
+        footprint = dict(
             slots=args.slots,
             chunk=args.chunk,
             prefill_buckets=parse_buckets(
@@ -618,7 +750,23 @@ def main(argv=None) -> int:
             qmode=args.qmode,
             tp=args.tp,
             spec_depth=args.spec_depth,
-            compile_step=not args.lower_only,
+            # sampling statics ride the content address; defaults track
+            # the serving/fleet CLI defaults (temperature 0.8), NOT the
+            # dataclass defaults, so default warm hits default serve
+            sample=SampleConfig(
+                args.temperature, args.top_k, args.top_p,
+                eos_token=args.eos,
+            ),
+        )
+        if args.cmd == "warm":
+            report = warm(model, store, **footprint)
+            print(json.dumps(report))
+            for msg in report["publish_errors"]:
+                print(f"aot warm: publish failed: {msg}", file=sys.stderr)
+            return 1 if report["publish_errors"] else 0
+        report = decode_plan(
+            model, compile_step=not args.lower_only, store=store,
+            **footprint,
         )
         if args.verify:
             mismatches = verify_decode_plan(report)

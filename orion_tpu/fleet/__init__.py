@@ -17,7 +17,9 @@ router, not a cache fabric. The pieces:
   channel breaks, and serializes turns per conversation fleet-wide.
 - :mod:`supervisor` — :class:`Supervisor`: heartbeats, degraded ⇒
   SIGTERM-drain-and-respawn (in-flight conversations continue elsewhere
-  with zero lost turns), exit ⇒ respawn, spawn retries.
+  with zero lost turns), exit ⇒ respawn, spawn retries; opt-in elastic
+  autoscaling (:class:`AutoscalePolicy`) over queue depth, capacity
+  headroom and SLO burn, plus :meth:`Supervisor.morph` footprint rolls.
 
 ``python -m orion_tpu.fleet`` is the CLI (``--replicas --session-dir
 --max-inflight`` plus the engine knobs ``--slots --chunk
@@ -35,9 +37,9 @@ from orion_tpu.fleet.replica import (
     ReplicaSpec,
 )
 from orion_tpu.fleet.router import Router
-from orion_tpu.fleet.supervisor import Supervisor
+from orion_tpu.fleet.supervisor import AutoscalePolicy, Supervisor
 
 __all__ = [
-    "FleetPending", "LocalReplica", "ProcessReplica", "ReplicaGone",
-    "ReplicaHandle", "ReplicaSpec", "Router", "Supervisor",
+    "AutoscalePolicy", "FleetPending", "LocalReplica", "ProcessReplica",
+    "ReplicaGone", "ReplicaHandle", "ReplicaSpec", "Router", "Supervisor",
 ]

@@ -92,6 +92,19 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="declare the first N tokens of every prompt as a "
                         "shared cacheable prefix (miss publishes to "
                         "--prefix-dir; 0 = never publish)")
+    p.add_argument("--exec-dir", default=None,
+                   help="SHARED content-addressed AOT executable store "
+                        "(ISSUE 20): replicas load their decode programs "
+                        "pre-compiled from here (publish via 'python -m "
+                        "orion_tpu.aot warm' or the first compiling "
+                        "replica) — a spawn becomes a download, not a "
+                        "compile; any miss falls back to jit")
+    p.add_argument("--autoscale", type=int, default=0,
+                   help="elastic fleet: let the supervisor move the "
+                        "replica count between 1 and this many on "
+                        "capacity headroom / queue depth / SLO burn "
+                        "(0 = fixed fleet); scale-in drains through the "
+                        "shared session store, zero lost turns")
     p.add_argument("--pin-cores", action="store_true",
                    help="pin each replica's XLA compute pool to one core "
                         "(rotating by replica index) — without it one "
@@ -178,6 +191,7 @@ def _spec_from_args(args) -> ReplicaSpec:
         "spec_depth": args.spec_depth,
         "spec_min_accept": args.spec_min_accept,
         "prefix_dir": args.prefix_dir,
+        "exec_dir": args.exec_dir,
         # cost attribution + capacity inside every replica; the ledger
         # harvest (a one-time lower at child startup, memoized) gives
         # the fleet real flops figures instead of the analytic fallback
@@ -310,9 +324,22 @@ def main(argv=None) -> int:
     else:
         lines = [ln for ln in lines if ln]
 
+    autoscale = None
+    if args.autoscale > 0:
+        from orion_tpu.fleet.supervisor import AutoscalePolicy
+
+        # queue pressure keyed to the per-replica admission bound: the
+        # fleet scales out when the average replica's queue is full —
+        # the leading edge of a load step, well before tokens/s moves
+        autoscale = AutoscalePolicy(
+            min_replicas=1,
+            max_replicas=max(args.autoscale, args.replicas),
+            queue_high=float(args.replica_max_inflight),
+            queue_low=max(args.replica_max_inflight / 4.0, 1.0),
+        )
     sup = Supervisor(
         factory, args.replicas, max_inflight=args.max_inflight,
-        tracer=tracer,
+        tracer=tracer, autoscale=autoscale,
     ).start()
     sup.start_monitor(interval=args.heartbeat_s)
     rc = 0

@@ -96,6 +96,22 @@ class Router:
                     return
             self.replicas.append(new)
 
+    def add(self, new: ReplicaHandle) -> None:
+        """Grow the fleet by one replica (autoscale scale-out): the
+        handle joins the candidate set atomically — the next ``submit``
+        may already place work on it."""
+        with self._lock:
+            self.replicas.append(new)
+
+    def remove(self, old: ReplicaHandle) -> None:
+        """Shrink the fleet (autoscale scale-in): drop ``old`` from the
+        candidate set. The caller drains it AFTERWARD — removal first
+        means no new dispatch can race onto a replica that is about to
+        suspend its sessions; work already in flight on it resolves
+        through its own pending handles, untouched by the roster."""
+        with self._lock:
+            self.replicas[:] = [r for r in self.replicas if r is not old]
+
     def _candidates(self, session_id: Optional[str] = None) -> List[Tuple]:
         """Routable replicas, best-first: (affinity, health rank,
         inflight, slo penalty, index). DRAINING/DEAD/dead-process

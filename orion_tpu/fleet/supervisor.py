@@ -31,6 +31,14 @@ deterministically and production runs it on a thread:
   layer with the ``fleet.replica_spawn`` hook inside the retried region,
   so a transient spawn failure (fork pressure, a slow filesystem) is a
   backoff, not a capacity loss.
+- **elastic autoscaling** (ISSUE 20, opt-in via :class:`AutoscalePolicy`)
+  — the same tick also runs a scale control loop over capacity headroom,
+  queue depth and SLO burn, with double-ended hysteresis; scale-in
+  drains its victim through the shared session store (zero lost turns)
+  and :meth:`morph` rolls the whole fleet onto a new footprint the same
+  way. With a warm exec store in the replica spec, a scale-out spawn
+  deserializes its decode programs instead of compiling them — elastic
+  capacity in milliseconds, not compile-minutes.
 
 Draining the LAST healthy replica is still correct — the router rejects
 while nothing is routable and heals when the respawn reports ready — but
@@ -40,6 +48,7 @@ stays one replica wide.
 
 from __future__ import annotations
 
+import dataclasses
 import sys
 import threading
 import time
@@ -53,6 +62,50 @@ from orion_tpu.resilience.retry import RetryPolicy, call_with_retries
 
 from orion_tpu.fleet.replica import ReplicaHandle
 from orion_tpu.fleet.router import Router
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """When the supervisor may move N (ISSUE 20). Three pressure
+    signals, every one read from state the tick's own heartbeats just
+    refreshed (the autoscaler never issues an extra status RPC):
+
+    - **capacity headroom** — ``fleet_capacity`` recomputed over the
+      live replicas' registry snapshots; below ``scale_out_headroom``
+      the fleet is near its measured ceiling, above
+      ``scale_in_headroom`` it is paying for idle replicas.
+    - **queue depth** — fleet in-flight per live replica against
+      ``queue_high`` (pressure) / ``queue_low`` (surplus); 0 disarms
+      the signal. This is the LEADING signal: a step-function load
+      doubling shows up in the admission queues a full capacity-window
+      before the tokens/s gauges move.
+    - **fast burn** — any replica's SLO fast-burn alert firing counts
+      as pressure (more capacity is the first response to a latency
+      burn) and vetoes surplus; burn never votes scale-in.
+
+    Hysteresis is double-ended: pressure must persist ``up_ticks``
+    consecutive ticks before a spawn, surplus ``down_ticks`` before a
+    drain (asymmetric on purpose — adding capacity late costs latency,
+    removing it early costs a respawn), and every move starts a
+    ``cooldown_ticks`` refractory window so the loop measures the NEW
+    fleet before moving again (a fresh replica's first heartbeats carry
+    empty windows that would otherwise read as surplus).
+
+    Scale-in is loss-free by construction: the victim (least-loaded) is
+    removed from the router FIRST (no new dispatch can race onto it),
+    then SIGTERM-drained — in-flight work completes, resident sessions
+    suspend to the shared store, and their conversations resume on the
+    survivors. Zero lost turns, same contract as a drain-respawn."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    scale_out_headroom: float = 0.15
+    scale_in_headroom: float = 0.60
+    queue_high: float = 0.0  # in-flight per live replica; 0 = disarmed
+    queue_low: float = 0.0
+    up_ticks: int = 2
+    down_ticks: int = 5
+    cooldown_ticks: int = 5
 
 
 class Supervisor:
@@ -74,6 +127,7 @@ class Supervisor:
         spawn_retry: Optional[RetryPolicy] = None,
         clock: Callable[[], float] = time.monotonic,
         tracer=None,
+        autoscale: Optional[AutoscalePolicy] = None,
     ):
         assert n >= 1, n
         self.factory = factory
@@ -93,6 +147,11 @@ class Supervisor:
         self._misses: dict = {}
         self._burns: dict = {}  # consecutive fast-burn heartbeats
         self._suppressed: set = set()  # store-outage respawns suppressed
+        self.autoscale = autoscale
+        self._up_streak = 0  # consecutive pressure ticks
+        self._down_streak = 0  # consecutive surplus ticks
+        self._cooldown = 0  # refractory ticks left after a move
+        self._last_signals: dict = {}  # last tick's evaluated signals
         self.replicas: List[ReplicaHandle] = []
         self.router: Optional[Router] = None
         self.events: List[tuple] = []  # (t, replica name, what) audit log
@@ -246,6 +305,8 @@ class Supervisor:
                         )
                 else:
                     self._burns[replica.name] = 0
+        if self.autoscale is not None and self.router is not None:
+            self._autoscale_tick()
 
     def _drain_respawn(self, idx: int, replica: ReplicaHandle,
                        why: str) -> None:
@@ -268,6 +329,143 @@ class Supervisor:
         # built the router (the replicas list IS the router's list)
         assert self.router is not None
         self.router.replace(old, new)
+
+    # -- elastic autoscaling (ISSUE 20) ---------------------------------------
+
+    def _autoscale_tick(self) -> None:
+        """One control-loop pass: evaluate the three pressure signals
+        against the policy, advance the hysteresis streaks, and move N
+        by AT MOST one replica. Everything here reads the heartbeat
+        snapshots this tick already refreshed — the autoscaler adds
+        zero control-channel traffic."""
+        pol = self.autoscale
+        alive = [r for r in self.replicas if r.alive]
+        n_live = len(alive)
+        snaps = [
+            s for s in (getattr(r, "last_status", None) for r in alive) if s
+        ]
+        metrics = [s["metrics"] for s in snaps if s.get("metrics")]
+        headroom = None
+        if metrics:
+            cap = obs_cost.fleet_capacity(obs_metrics.aggregate(metrics))
+            if not cap.get("no_data"):
+                headroom = cap["headroom"]
+        inflight = sum(r.inflight for r in alive)
+        queue_pressure = (
+            pol.queue_high > 0 and n_live > 0
+            and inflight >= pol.queue_high * n_live
+        )
+        queue_surplus = (
+            pol.queue_high > 0 and inflight <= pol.queue_low * n_live
+        )
+        burn_pressure = any(
+            bool((s.get("slo") or {}).get("firing_fast")) for s in snaps
+        )
+        pressure = queue_pressure or burn_pressure or (
+            headroom is not None and headroom < pol.scale_out_headroom
+        )
+        surplus = not pressure and (
+            (headroom is not None and headroom > pol.scale_in_headroom)
+            or (headroom is None and queue_surplus)
+        )
+        if pressure:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif surplus:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._down_streak = 0
+        self._last_signals = {
+            "headroom": headroom, "inflight": inflight, "live": n_live,
+            "queue_pressure": queue_pressure, "burn_pressure": burn_pressure,
+            "pressure": pressure, "surplus": surplus,
+            "up_streak": self._up_streak, "down_streak": self._down_streak,
+            "cooldown": self._cooldown,
+        }
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return
+        if (pressure and self._up_streak >= pol.up_ticks
+                and n_live < pol.max_replicas):
+            why = ("queue" if queue_pressure
+                   else "burn" if burn_pressure else "headroom")
+            self._scale_out(why)
+        elif (surplus and self._down_streak >= pol.down_ticks
+                and n_live > pol.min_replicas):
+            self._scale_in()
+
+    def _scale_out(self, why: str) -> None:
+        """Spawn one replica into a FRESH slot index (max existing + 1:
+        scale-in may have left holes and a reused name would alias
+        per-slot resources like a pinned core) and add it to the
+        router's candidate set. With a warm exec store in the spec the
+        spawn is a download, not a compile — the millisecond-replica
+        path this control loop exists for."""
+        idx = max(
+            (self.replica_index(r.name) for r in self.replicas), default=-1
+        ) + 1
+        new = self._spawn(idx)
+        assert self.router is not None
+        self.router.add(new)
+        self.n = len(self.router.replicas)
+        self._cooldown = self.autoscale.cooldown_ticks
+        self._up_streak = self._down_streak = 0
+        self._event(new.name, f"scale_out ({why})")
+
+    def _scale_in(self) -> None:
+        """Retire the least-loaded replica, loss-free: remove it from
+        the router FIRST (no new dispatch can land on it), then drain —
+        in-flight work completes and resident sessions suspend to the
+        shared store, where the survivors resume them. Ties break
+        toward the HIGHEST slot index so the fleet shrinks from the
+        top and slot-keyed resources stay dense."""
+        alive = [r for r in self.replicas if r.alive]
+        if not alive:
+            return
+        victim = min(
+            alive,
+            key=lambda r: (r.inflight, -self.replica_index(r.name)),
+        )
+        assert self.router is not None
+        self.router.remove(victim)
+        self.n = len(self.router.replicas)
+        self._cooldown = self.autoscale.cooldown_ticks
+        self._up_streak = self._down_streak = 0
+        self._event(victim.name, "scale_in; draining")
+        victim.drain()
+        if not victim.join(timeout=self.drain_grace):
+            self._event(victim.name, "scale_in drain overran grace; killing")
+            victim.kill()
+            victim.join(timeout=10.0)
+        self._misses.pop(victim.name, None)
+        self._burns.pop(victim.name, None)
+        self._suppressed.discard(victim.name)
+
+    def autoscale_state(self) -> dict:
+        """The control loop's last evaluated signals + streaks — the
+        debug view a bench or /statusz consumer reads to see WHY the
+        fleet did (or didn't) move."""
+        return dict(self._last_signals)
+
+    def morph(self, factory: Callable[[str], ReplicaHandle],
+              *, why: str = "morph") -> None:
+        """Footprint morphing: swap EVERY replica to the shape the new
+        ``factory`` builds (a bigger tp mesh, different slots/chunk) by
+        rolling drain-respawn — one replica at a time, so the routable
+        window never shrinks by more than one. Mid-conversation safety
+        rides the session store's portability contract: the suspended
+        carry row is logical (footprint-free), so a session suspended
+        on the old shape resumes BITWISE on the new one (ISSUE 14
+        pinned tp-flips; a qmode flip changes the weights identity and
+        is NOT migration-safe — spell it as a new fleet). The new
+        factory also becomes the respawn/scale-out factory: every
+        future replica is born the new shape."""
+        self.factory = factory
+        for idx, replica in enumerate(list(self.replicas)):
+            if replica is not self.replicas[idx]:
+                continue  # replaced mid-roll
+            self._drain_respawn(idx, replica, why)
 
     # -- fleet-level observability --------------------------------------------
 
@@ -350,4 +548,4 @@ class Supervisor:
             replica.join(timeout=10.0)
 
 
-__all__ = ["Supervisor"]
+__all__ = ["AutoscalePolicy", "Supervisor"]

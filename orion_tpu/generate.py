@@ -22,7 +22,7 @@ import argparse
 import dataclasses
 import sys
 from functools import partial
-from typing import Any, List, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -186,6 +186,7 @@ def reprefill_carry(
     rng: Array,
     buckets: Tuple[int, ...] = (),
     sample_index: Optional[int] = None,
+    exec_lookup: Optional[Callable[[int], Any]] = None,
 ):
     """Rebuild a decode carry from prompt + the tokens already emitted —
     the degradation ladder's re-prefill rung, shared by the solo
@@ -217,7 +218,7 @@ def reprefill_carry(
     return prefill_carry(
         model, params, seq, sample_cfg, rng,
         sample_index=n if sample_index is None else sample_index,
-        done=done, buckets=buckets,
+        done=done, buckets=buckets, exec_lookup=exec_lookup,
     )
 
 
@@ -230,6 +231,7 @@ def prefill_carry(
     sample_index: int = 0,
     done: Optional[Array] = None,
     buckets: Tuple[int, ...] = (),
+    exec_lookup: Optional[Callable[[int], Any]] = None,
 ):
     """tokens [B, T] -> the decode carry (next_token, states, t, done).
 
@@ -241,7 +243,14 @@ def prefill_carry(
     ``buckets``: sorted pad-to lengths for bucketed prefill (empty = off).
     The prompt is right-padded to the smallest bucket >= T and the real
     length rides in traced, so the jit cache stays bounded by the bucket
-    count; a prompt longer than every bucket falls back to exact-length."""
+    count; a prompt longer than every bucket falls back to exact-length.
+
+    ``exec_lookup``: bucket width -> an AOT-deserialized executable of
+    THIS program (serving/exec_store.py) or None. A hit replaces the jit
+    dispatch — the stored artifact was compiled from the identical
+    program by the identical compiler, so its outputs are bitwise the
+    wrapper's; statics (model, sample_cfg) are baked into it, the call
+    passes only the dynamic operands."""
     tokens = jnp.asarray(tokens, jnp.int32)
     if done is None:
         done = jnp.zeros((tokens.shape[0],), bool)
@@ -251,6 +260,12 @@ def prefill_carry(
         # a bucket-exact prompt still goes through the bucketed compile
         # (length == pad_to): ONE cache entry per bucket, period
         padded = jnp.pad(tokens, ((0, 0), (0, pad_to - t)))
+        exe = exec_lookup(pad_to) if exec_lookup is not None else None
+        if exe is not None:
+            return exe(
+                params, padded, rng, jnp.int32(sample_index), done,
+                jnp.int32(t),
+            )
         return _prefill_carry_bucketed_jit(
             model, params, padded, sample_cfg, rng,
             jnp.int32(sample_index), done, jnp.int32(t),

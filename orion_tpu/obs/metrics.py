@@ -388,6 +388,28 @@ def prometheus_from_snapshot(snap: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def snapshot_value(
+    snap: dict, name: str, labels: Optional[Dict[str, str]] = None
+):
+    """One cell out of a snapshot-shaped dict (``MetricsRegistry
+    .snapshot()`` or :func:`aggregate`'s rollup): the value of the
+    counter or gauge row matching ``name`` — and, when ``labels`` is
+    given, exactly those labels. ``None`` when no row matches; with
+    ``name`` alone and several labeled cells, their sum (the flat
+    counter semantics of ``counters_flat``, but against a snapshot a
+    bench or test already holds instead of a live registry)."""
+    want = dict(labels) if labels is not None else None
+    total = None
+    for section in ("counters", "gauges"):
+        for row in snap.get(section, ()):
+            if row.get("name") != name:
+                continue
+            if want is not None and dict(row.get("labels") or {}) != want:
+                continue
+            total = (total or 0) + row["value"]
+    return total
+
+
 def aggregate(
     snapshots: List[dict], sources: Optional[List[str]] = None
 ) -> dict:
@@ -447,5 +469,5 @@ def aggregate(
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "aggregate",
-    "prometheus_from_snapshot", "DEFAULT_MS_BUCKETS",
+    "prometheus_from_snapshot", "snapshot_value", "DEFAULT_MS_BUCKETS",
 ]

@@ -122,6 +122,13 @@ SITES = {
                          "retried write of one prefix generation",
     "serve.prefix_load": "serving/prefix_store.py lookup, inside the "
                          "retried read of one candidate generation",
+    "serve.exec_scan": "serving/exec_store.py _io_listdir, before the "
+                       "directory listing — the existence probe of an "
+                       "executable lookup/publish",
+    "serve.exec_save": "serving/exec_store.py publish, inside the retried "
+                       "write of one serialized-executable generation",
+    "serve.exec_load": "serving/exec_store.py lookup, inside the retried "
+                       "read of one candidate generation",
     "fleet.dispatch": "fleet/router.py submit, before each placement "
                       "attempt (step = fleet-wide dispatch ordinal)",
     "fleet.replica_spawn": "fleet/supervisor.py _spawn, inside the spawn "

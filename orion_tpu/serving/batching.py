@@ -515,6 +515,15 @@ class SlotEngine:
         # compile time for the cost ledger); unified keys include the
         # staged-buffer width — a wider bucket is a new program
         self._compile_seen: set = set()
+        # AOT warm start (serving/exec_store.py): per-key deserialized
+        # executables installed in place of the jit wrappers, and the
+        # keys already consulted (one store lookup per program per
+        # engine lifetime — a miss means this engine compiles the
+        # program exactly once, so miss == fallback compile, counted)
+        self._exec_store: Optional[Any] = None
+        self._exec_qmode = "off"
+        self._warm_execs: Dict[Any, Any] = {}
+        self._warm_checked: set = set()
         if mesh is not None:
             from orion_tpu.parallel.decode import (
                 place_decode_carry,
@@ -549,6 +558,82 @@ class SlotEngine:
                 "entries at non-chunk positions cannot extend bitwise"
             )
         self.prefix_store = store
+
+    def attach_exec_store(self, store, qmode: str = "off") -> None:
+        """Wire an :class:`~orion_tpu.serving.exec_store.ExecStore`:
+        each program's FIRST launch consults the store (once per key
+        per engine lifetime) and a hit installs the deserialized
+        executable in place of the jit wrapper — same program, same
+        compiler, bitwise outputs, milliseconds instead of a compile. A
+        miss (or any store damage) falls through to jit and is counted
+        as the fallback compile it implies; the request path NEVER
+        fails here. ``qmode`` names the quantization layout the params
+        already carry — part of every executable's content address."""
+        self._exec_store = store
+        self._exec_qmode = str(qmode or "off")
+
+    def _sample_fp(self) -> str:
+        from orion_tpu.serving.exec_store import sample_fingerprint
+
+        return sample_fingerprint(
+            self._sample if self._sample is not None else SampleConfig()
+        )
+
+    def _warm_boundary_exec(self, kind: str, seen_key) -> Optional[Any]:
+        """The warm executable for one boundary program, or None. The
+        ident dict is built EXACTLY as ``aot.decode_plan`` keys its
+        inventory (Tier E's closed universe) — that equality is what
+        makes a warmed footprint hit on all of its programs."""
+        if self._exec_store is None:
+            return None
+        exe = self._warm_execs.get(seen_key)
+        if exe is not None or seen_key in self._warm_checked:
+            return exe
+        self._warm_checked.add(seen_key)
+        if kind == "spec_round":
+            ident = {"kind": kind, "slots": self.slots,
+                     "spec_depth": self.spec_depth,
+                     "qmode": self._exec_qmode, "tp": self.tp}
+        else:
+            ident = {"kind": kind, "slots": self.slots,
+                     "chunk": self.chunk, "qmode": self._exec_qmode,
+                     "tp": self.tp}
+            if kind == "unified_prefill":
+                ident["bucket"] = int(self._pbuf.shape[1])
+                ident["prefill_chunk"] = self.prefill_chunk
+        t0 = time.monotonic()
+        exe = self._exec_store.lookup(ident, self._sample_fp())
+        if exe is None:
+            # one-compile-per-key contract: this miss is exactly one
+            # jit compile this engine now pays
+            self._exec_store.count_fallback()
+            return None
+        self._warm_execs[seen_key] = exe
+        self._emit("program_warm", program=kind,
+                   ms=round((time.monotonic() - t0) * 1e3, 3))
+        return exe
+
+    def _warm_prefill_exec(self, bucket: int) -> Optional[Any]:
+        """``exec_lookup`` callback for :func:`generate.prefill_carry`:
+        the warm bucketed-prefill executable for ``bucket``, or None."""
+        if self._exec_store is None:
+            return None
+        seen_key = ("prefill_bucketed", int(bucket))
+        exe = self._warm_execs.get(seen_key)
+        if exe is not None or seen_key in self._warm_checked:
+            return exe
+        self._warm_checked.add(seen_key)
+        ident = {"kind": "prefill_bucketed", "bucket": int(bucket),
+                 "qmode": self._exec_qmode, "tp": self.tp}
+        t0 = time.monotonic()
+        exe = self._exec_store.lookup(ident, self._sample_fp())
+        if exe is None:
+            self._exec_store.count_fallback()
+            return None
+        self._warm_execs[seen_key] = exe
+        self._emit("program_warm", program="prefill_bucketed",
+                   ms=round((time.monotonic() - t0) * 1e3, 3))
+        return exe
 
     # -- occupancy ------------------------------------------------------------
 
@@ -683,6 +768,7 @@ class SlotEngine:
             sub = prefill_carry(
                 self.model, self.params, prompt, self._sample, rng,
                 sample_index=sample_index, buckets=self.buckets,
+                exec_lookup=self._warm_prefill_exec,
             )
             self._insert(i, sub, rng, n_emitted=sample_index)
         self._slots[i] = _Slot(
@@ -914,6 +1000,7 @@ class SlotEngine:
                 carry = prefill_carry(
                     self.model, self.params, row, self._sample,
                     jax.random.PRNGKey(0), buckets=self.buckets,
+                    exec_lookup=self._warm_prefill_exec,
                 )
                 gen = self.prefix_store.publish(row, carry[1])
                 if gen is None:
@@ -1257,23 +1344,42 @@ class SlotEngine:
 
             jf = DECODE_PROGRAMS[kind]
             watch = (jf, jf._cache_size(), time.monotonic())
+        # AOT warm start: a stored executable (same program, same
+        # compiler) replaces the jit dispatch — statics are baked into
+        # the artifact, so the warm calls pass only the dynamic operands
+        # in the wrapper's positional order
+        warm = self._warm_boundary_exec(kind, seen_key)
         accepted = None
         if spec is not None:
-            out, toks, accepted = decode_batched_spec_round(
-                self.model, self.params, carry, self._rngs, active_dev,
-                spec, self.spec_depth, self._sample,
-            )
+            if warm is not None:
+                out, toks, accepted = warm(
+                    self.params, carry, self._rngs, active_dev, spec
+                )
+            else:
+                out, toks, accepted = decode_batched_spec_round(
+                    self.model, self.params, carry, self._rngs, active_dev,
+                    spec, self.spec_depth, self._sample,
+                )
         elif unified:
-            out, toks = decode_batched_prefill_chunk(
-                self.model, self.params, carry, self._rngs, active_dev,
-                self._pbuf, self._plen, self._pfold, self.chunk,
-                self.prefill_chunk, self._sample,
-            )
+            if warm is not None:
+                out, toks = warm(
+                    self.params, carry, self._rngs, active_dev,
+                    self._pbuf, self._plen, self._pfold,
+                )
+            else:
+                out, toks = decode_batched_prefill_chunk(
+                    self.model, self.params, carry, self._rngs, active_dev,
+                    self._pbuf, self._plen, self._pfold, self.chunk,
+                    self.prefill_chunk, self._sample,
+                )
         else:
-            out, toks = decode_batched_chunk(
-                self.model, self.params, carry, self._rngs, active_dev,
-                self.chunk, self._sample,
-            )
+            if warm is not None:
+                out, toks = warm(self.params, carry, self._rngs, active_dev)
+            else:
+                out, toks = decode_batched_chunk(
+                    self.model, self.params, carry, self._rngs, active_dev,
+                    self.chunk, self._sample,
+                )
         if watch is not None:
             jf, before, t0 = watch
             self._compile_seen.add(seen_key)
@@ -1401,6 +1507,7 @@ class SlotEngine:
         sub = reprefill_carry(
             self.model, self.params, slot.prompt, emitted, self._sample,
             rng, buckets=self.buckets, sample_index=fold,
+            exec_lookup=self._warm_prefill_exec,
         )
         new_snap, self._rngs, self._plen, self._pfold = _insert_carry(
             snap, self._rngs, self._plen, self._pfold, sub, rng,

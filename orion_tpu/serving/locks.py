@@ -47,6 +47,12 @@ here so the next reader does not "fix" them:
 - The SlotEngine's bookkeeping is guarded by ``engine.exec`` only for
   mesh engines; unsharded engines swap in a ``nullcontext`` because the
   scheduler thread is the sole writer (thread confinement, PR 14).
+- ``ExecStore.stats`` (serving/exec_store.py) takes no lock: the int
+  slots are written only by the store's owner thread (the engine
+  scheduler at serving time, the CLI main thread under ``aot warm``)
+  and read by metrics gauge closures — single-writer, GIL-published,
+  staleness-tolerant, same contract as ``ProcessReplica.last_status``.
+  The resident-executable LRU is owner-thread-confined the same way.
 """
 
 from __future__ import annotations

@@ -136,6 +136,17 @@ class ServeConfig:
     # for one model per store, but pin it when several checkpoints of
     # one config share a prefix_dir (the CLIs do).
     params_id: Optional[str] = None
+    # -- AOT executable store (serving/exec_store.py); None = disabled.
+    # A spawned replica DOWNLOADS its decode programs (serialized by
+    # `python -m orion_tpu.aot warm`) instead of compiling them —
+    # spawn-to-first-token drops from a compile storm to milliseconds of
+    # deserialization. Every miss, version skew, or damaged entry
+    # degrades to the jit compile with a counter, never an error.
+    exec_dir: Optional[str] = None
+    # node-local warm tier in front of the shared exec_dir (write-through
+    # on shared hits); None = two tiers only (in-process LRU + shared)
+    exec_local_dir: Optional[str] = None
+    exec_max_resident: int = 32  # LRU cap on loaded executables
     # -- durable sessions (session_store.py); None = sessions disabled --
     session_dir: Optional[str] = None  # on-disk session store root
     session_idle_s: float = 300.0  # resident-cache idle eviction (0 = off)
@@ -477,6 +488,41 @@ class Server:
                 breaker=self._make_breaker("prefix"),
             )
             self.engine.attach_prefix_store(self.prefix_store)
+        # -- AOT executable store (ROADMAP item 1): the engine's first
+        # launch of each program consults it and a hit installs the
+        # deserialized executable — a warmed replica reaches its first
+        # token without one compile. Its breaker joins the failure-
+        # domain registry: an outage degrades to cold compiles (counted
+        # misses), never failed requests, and health reports
+        # store-outage:exec so the supervisor doesn't churn the replica.
+        self.exec_store = None
+        self._h_exec_load_ms = self.metrics.histogram("exec_load_ms")
+        self._h_exec_save_ms = self.metrics.histogram("exec_save_ms")
+        if cfg.exec_dir:
+            from orion_tpu.serving.exec_store import ExecStore
+
+            self.exec_store = ExecStore(
+                cfg.exec_dir, identity=self._weights_identity,
+                local_dir=cfg.exec_local_dir,
+                max_resident=cfg.exec_max_resident,
+                should_abort=lambda: not self.health.accepting,
+                observer=self._on_exec_io, clock=clock,
+                breaker=self._make_breaker("exec"),
+            )
+            self.engine.attach_exec_store(self.exec_store, qmode=self.qmode)
+            for stat in ("hits", "misses", "publishes",
+                         "fallback_compiles", "errors"):
+                # single-writer int reads (the scheduler owns the stats
+                # dict) — host-only, like every gauge_fn provider
+                self.metrics.gauge_fn(
+                    "exec_store_events",
+                    lambda s=stat: self.exec_store.stats[s],
+                    labels={"event": stat},
+                )
+            self.metrics.gauge_fn(
+                "exec_store_resident",
+                lambda: self.exec_store.resident_count(),
+            )
         # the gauges we used to fly blind on — all callable (evaluated at
         # scrape time from live host state) and all free: queue depth,
         # per-slot prefill-vs-decode occupancy, compile-cache sizes
@@ -664,6 +710,10 @@ class Server:
          else self._h_prefix_load_ms).observe(ms)
         self._c_prefix_bytes.inc(nbytes, labels={"op": op})
 
+    def _on_exec_io(self, op: str, ms: float, nbytes: int) -> None:
+        (self._h_exec_save_ms if op == "save"
+         else self._h_exec_load_ms).observe(ms)
+
     # -- storage failure domains (ISSUE 17) -----------------------------------
 
     _BREAKER_GAUGE = {"closed": 0, "half_open": 1, "open": 2}
@@ -761,6 +811,11 @@ class Server:
             probes.append(("prefix", self.prefix_store.list_keys))
         if self.session_store is not None and not self._dirty_sessions:
             probes.append(("session", self.session_store.list_sessions))
+        if self.exec_store is not None:
+            # the exec store NEVER has pending work after the engine's
+            # per-key lookups ran once — without this probe a breaker
+            # that tripped during warm-up would pin DEGRADED forever
+            probes.append(("exec", self.exec_store.list_keys))
         for name, scan in probes:
             br = self._breakers.get(name)
             if br is None or not br.is_open or not br.allow():
@@ -841,6 +896,16 @@ class Server:
                 "max_dirty_sessions": self.cfg.max_dirty_sessions,
                 "prefix_publish_drops": flat.get("prefix_publish_drops", 0),
                 "pending_prefix_publishes": self.engine.pending_prefix_count,
+            }
+        if self.exec_store is not None:
+            # the warm-start section: hit/miss/fallback tallies answer
+            # "did this replica compile anything it shouldn't have?" —
+            # fallback_compiles > 0 after an aot warm pass is the signal
+            # that the store's identity and the engine's diverged
+            snap["exec_store"] = {
+                "identity": self.exec_store.identity,
+                "stats": dict(self.exec_store.stats),
+                "resident": self.exec_store.resident_count(),
             }
         snap["flight_tail"] = self.flight.events()[-20:]
         return snap

@@ -1,0 +1,478 @@
+"""AOT executable store (ISSUE 20): content addressing, tiering, damage
+and outage degradation, staleness gc, and the engine-level warm path.
+
+The serving contract under test: a replica with a warm store DOWNLOADS
+its decode programs instead of compiling them, and every possible store
+failure — truncated payload, corrupt pickle, manifest skew, full outage
+behind an open breaker — degrades to a counted MISS that the engine's
+jit fallback absorbs. A request never fails because of this store.
+
+Quick tier stays host-cheap: the store tests serialize one TRIVIAL
+compiled executable (a scalar add — milliseconds). The real decode-plan
+round trips (bitwise warm serving, corrupt-store jit fallback under a
+live engine) compile genuine programs and are marked ``slow``, keeping
+the tier-1 budget where the seed left it.
+
+Chaos sites exercised here (the resilience meta-test requires the
+literals): ``serve.exec_scan``, ``serve.exec_load``, ``serve.exec_save``.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.resilience import inject
+from orion_tpu.resilience.breaker import CircuitBreaker, StoreUnavailableError
+from orion_tpu.resilience.retry import RetryPolicy
+from orion_tpu.serving.exec_store import (
+    ExecStore,
+    decl_fingerprint,
+    sample_fingerprint,
+)
+from orion_tpu.serving.exec_store import main as exec_store_main
+
+pytestmark = pytest.mark.chaos
+
+IDENT = {"kind": "decode_batched", "slots": 2, "chunk": 4, "qmode": "off"}
+
+
+def _trivial_compiled():
+    """A real, serializable XLA executable that costs milliseconds."""
+    return (
+        jax.jit(lambda x: x + 1.0)
+        .lower(jnp.zeros((4,), jnp.float32))
+        .compile()
+    )
+
+
+def _store(tmp_path, name="shared", **kw):
+    kw.setdefault("retry", RetryPolicy(attempts=1))
+    return ExecStore(str(tmp_path / name), identity="pid|off", **kw)
+
+
+# ---------------------------------------------------------------------------
+# content addressing
+# ---------------------------------------------------------------------------
+
+
+def test_key_covers_every_identity_axis(tmp_path):
+    """The address must move when ANY validity input moves: weights
+    identity, plan ident, sampling fingerprint, declaration. Equal
+    inputs must collide exactly (racing publishers converge)."""
+    a = _store(tmp_path)
+    assert a.key_for(IDENT, "sf") == a.key_for(dict(IDENT), "sf")
+    assert a.key_for(IDENT, "sf") != a.key_for(IDENT, "other-sample")
+    assert a.key_for(IDENT, "sf") != a.key_for(dict(IDENT, chunk=8), "sf")
+    b = ExecStore(str(tmp_path / "shared"), identity="pid2|off")
+    assert a.key_for(IDENT, "sf") != b.key_for(IDENT, "sf")
+    # declared vs undeclared kinds hash through different decl routes
+    assert decl_fingerprint("decode_batched") != decl_fingerprint("bogus")
+    assert decl_fingerprint("bogus").startswith("undeclared:")
+
+
+def test_sample_fingerprint_is_a_jit_static(tmp_path):
+    from orion_tpu.generate import SampleConfig
+
+    assert sample_fingerprint(SampleConfig()) == sample_fingerprint(
+        SampleConfig()
+    )
+    assert sample_fingerprint(SampleConfig()) != sample_fingerprint(
+        SampleConfig(temperature=0.0)
+    )
+
+
+# ---------------------------------------------------------------------------
+# publish / lookup round trip and tiering (trivial executable)
+# ---------------------------------------------------------------------------
+
+
+def test_publish_lookup_roundtrip_and_tiers(tmp_path):
+    store = _store(tmp_path, local_dir=str(tmp_path / "local"))
+    assert not store.has(IDENT, "sf")
+    gen = store.publish(IDENT, _trivial_compiled(), "sf")
+    assert gen == 1 and store.has(IDENT, "sf")
+    # idempotent re-publish short-circuits on the committed generation
+    assert store.publish(IDENT, _trivial_compiled(), "sf") is None
+    exe = store.lookup(IDENT, "sf")
+    assert exe is not None
+    out = np.asarray(exe(jnp.ones((4,), jnp.float32)))
+    np.testing.assert_allclose(out, 2.0)
+    # resident LRU: the second lookup never touches disk
+    plan = inject.FaultPlan().add("serve.exec_scan", times=1)
+    with inject.inject(plan):
+        assert store.lookup(IDENT, "sf") is not None
+    assert not plan.delivered, "resident hit must not scan the store"
+    assert store.stats["hits"] == 2 and store.stats["misses"] == 0
+    # the shared hit wrote through to the node-local tier: a second
+    # consumer (fresh LRU) sharing local_dir hits without the shared dir
+    key = store.key_for(IDENT, "sf")
+    assert (tmp_path / "local" / key / "gen-000001.bin").exists()
+    other = ExecStore(
+        str(tmp_path / "gone"), identity="pid|off",
+        local_dir=str(tmp_path / "local"),
+    )
+    assert other.lookup(IDENT, "sf") is not None
+
+
+def test_exec_io_sites_fire_where_the_store_touches_disk(tmp_path):
+    """serve.exec_scan / serve.exec_save / serve.exec_load are live fire
+    points on the real syscall paths (scan on the existence probe, save
+    inside the retried publish write, load inside the retried read)."""
+    store = _store(tmp_path)
+    plan = inject.FaultPlan().add("serve.exec_scan", times=1)
+    with inject.inject(plan):
+        store.generations("nobody")
+    assert any(d.startswith("serve.exec_scan") for d in plan.delivered)
+    plan = inject.FaultPlan().add("serve.exec_save", times=1)
+    with inject.inject(plan):
+        store.publish(IDENT, _trivial_compiled(), "sf")
+    assert any(d.startswith("serve.exec_save") for d in plan.delivered)
+    plan = inject.FaultPlan().add("serve.exec_load", times=1)
+    with inject.inject(plan):
+        assert store.lookup(IDENT, "sf") is not None
+    assert any(d.startswith("serve.exec_load") for d in plan.delivered)
+
+
+# ---------------------------------------------------------------------------
+# damage: every corruption is a counted miss, never an exception
+# ---------------------------------------------------------------------------
+
+
+def test_truncated_payload_is_counted_miss(tmp_path):
+    store = _store(tmp_path)
+    store.publish(IDENT, _trivial_compiled(), "sf")
+    key = store.key_for(IDENT, "sf")
+    bin_path = tmp_path / "shared" / key / "gen-000001.bin"
+    bin_path.write_bytes(bin_path.read_bytes()[:32])
+    with pytest.warns(UserWarning, match="truncated"):
+        assert store.lookup(IDENT, "sf") is None
+    assert store.stats["errors"] >= 1 and store.stats["misses"] == 1
+
+
+def test_corrupt_pickle_is_counted_miss(tmp_path):
+    store = _store(tmp_path)
+    store.publish(IDENT, _trivial_compiled(), "sf")
+    key = store.key_for(IDENT, "sf")
+    d = tmp_path / "shared" / key
+    blob = b"\x80\x04not a pickle at all" * 8
+    (d / "gen-000001.bin").write_bytes(blob)
+    doc = json.loads((d / "gen-000001.json").read_text())
+    import hashlib
+
+    doc["nbytes"] = len(blob)
+    doc["sha256"] = hashlib.sha256(blob).hexdigest()
+    (d / "gen-000001.json").write_text(json.dumps(doc))
+    with pytest.warns(UserWarning, match="deserialize"):
+        assert store.lookup(IDENT, "sf") is None
+    assert store.stats["errors"] >= 1
+
+
+def test_runtime_skew_manifest_is_clean_miss(tmp_path):
+    """Defense in depth behind the key's runtime axis: a hand-moved
+    manifest claiming another jax/jaxlib is refused and degrades to a
+    miss (cold compile), never a deserialization crash."""
+    store = _store(tmp_path)
+    store.publish(IDENT, _trivial_compiled(), "sf")
+    key = store.key_for(IDENT, "sf")
+    d = tmp_path / "shared" / key
+    doc = json.loads((d / "gen-000001.json").read_text())
+    doc["runtime"] = "jax-0.0.1|jaxlib-0.0.1|tpu"
+    (d / "gen-000001.json").write_text(json.dumps(doc))
+    with pytest.warns(UserWarning, match="corrupt or incomplete"):
+        assert store.lookup(IDENT, "sf") is None
+    assert store.stats["misses"] == 1
+
+
+def test_damaged_generation_falls_back_to_previous(tmp_path):
+    """Generation degradation: a corrupt newest generation falls back to
+    the previous committed one — same contract as the prefix store."""
+    store = _store(tmp_path)
+    store.publish(IDENT, _trivial_compiled(), "sf")
+    gen2 = store.publish(IDENT, _trivial_compiled(), "sf",
+                         skip_if_present=False)
+    assert gen2 == 2
+    key = store.key_for(IDENT, "sf")
+    (tmp_path / "shared" / key / "gen-000002.bin").write_bytes(b"junk")
+    with pytest.warns(UserWarning):
+        exe = store.lookup(IDENT, "sf")
+    assert exe is not None, "gen 1 must serve when gen 2 is damaged"
+    assert store.stats["hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# outage: breaker opens, everything degrades to instant cold compile
+# ---------------------------------------------------------------------------
+
+
+def test_outage_opens_breaker_then_instant_misses(tmp_path):
+    """A sustained store outage trips the breaker on shared-tier OS
+    errors; while open every lookup is an O(1) host-work miss (delivery
+    log FROZEN — zero syscalls) and publish refuses fast; the half-open
+    probe closes it after recovery. The engine above sees only misses:
+    it compiles cold and keeps serving."""
+    t = [0.0]
+    br = CircuitBreaker("exec", consecutive_failures=2, backoff=1.0,
+                        jitter=0.0, clock=lambda: t[0])
+    store = _store(tmp_path, breaker=br)
+    store.publish(IDENT, _trivial_compiled(), "sf")
+    plan = inject.FaultPlan().degrade_site("serve.exec_", kind="eio")
+    with inject.inject(plan):
+        for _ in range(2):
+            assert store.lookup(IDENT, "sf") is None  # walk fails: miss
+        assert br.state == "open"
+        frozen = len(plan.delivered)
+        for _ in range(5):
+            assert store.lookup(IDENT, "sf") is None
+        with pytest.raises(StoreUnavailableError):
+            store.publish(IDENT, _trivial_compiled(), "sf",
+                          skip_if_present=False)
+        assert len(plan.delivered) == frozen, (
+            "open breaker must not touch disk"
+        )
+        assert store.stats["misses"] >= 7
+    t[0] = 1.5  # past the dwell, regime gone: the probe lookup recovers
+    assert store.lookup(IDENT, "sf") is not None
+    assert br.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# staleness: dead entries + the gc CLI
+# ---------------------------------------------------------------------------
+
+
+def test_dead_exec_entries_and_gc_cli(tmp_path, capsys):
+    """An entry whose kind lost its ProgramDecl (or whose declaration
+    drifted) is unreachable forever — content addressing hashes the live
+    universe to different keys. The staleness pass finds it and the
+    ``exec_store gc`` CLI prunes it; live entries are never touched."""
+    from orion_tpu.analysis.staleness import (
+        dead_exec_entries,
+        dead_exec_findings,
+    )
+
+    store = _store(tmp_path)
+    store.publish(IDENT, _trivial_compiled(), "sf")
+    store.publish({"kind": "bogus_program", "slots": 2},
+                  _trivial_compiled(), "sf")
+    drifted = dict(IDENT, chunk=16)
+    store.publish(drifted, _trivial_compiled(), "sf")
+    key_drift = store.key_for(drifted, "sf")
+    man = tmp_path / "shared" / key_drift / "gen-000001.json"
+    doc = json.loads(man.read_text())
+    doc["decl"] = "0" * 16  # a superseded declaration of a live kind
+    man.write_text(json.dumps(doc))
+
+    dead = dead_exec_entries(store.entries())
+    kinds = sorted(str(d["ident"]["kind"]) for d in dead)
+    assert kinds == ["bogus_program", "decode_batched"]
+    findings = dead_exec_findings(dead, str(tmp_path / "shared"))
+    assert len(findings) == 2
+    assert all(f.rule == "dead-exec-entry" for f in findings)
+
+    rc = exec_store_main(["ls", "--dir", str(tmp_path / "shared")])
+    out = capsys.readouterr().out
+    assert rc == 0 and "3 entries, 2 dead" in out
+    rc = exec_store_main(
+        ["gc", "--dry-run", "--dir", str(tmp_path / "shared")]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0 and "2 dead of 3 entries (dry run)" in out
+    assert len(store.entries()) == 3, "dry run must not delete"
+    rc = exec_store_main(["gc", "--dir", str(tmp_path / "shared")])
+    assert rc == 0
+    live = store.entries()
+    assert len(live) == 1
+    assert live[0]["ident"]["kind"] == "decode_batched"
+    assert live[0]["ident"].get("chunk") == 4  # the live entry survived
+
+
+def test_aot_warm_cli_derives_the_fleet_clis_address(monkeypatch, tmp_path):
+    """Default-flag parity between the publish and lookup halves: the
+    ``aot warm`` CLI must address the store EXACTLY as a CLI-launched
+    fleet replica will — the '<config>:ov=<fp>:seed=0' weights identity
+    (both serving CLIs always pass one explicitly; Server's config-hash
+    fallback never applies to them) and the CLIs' sampling statics
+    (temperature 0.8, not the SampleConfig dataclass's 1.0). Found the
+    hard way: a warm published under either mismatched default is a
+    store no lookup ever hits — fallback_compiles > 0 with zero errors."""
+    import orion_tpu.aot as aot
+    from orion_tpu.fleet.__main__ import build_argparser
+    from orion_tpu.generate import SampleConfig
+    from orion_tpu.serving import exec_store as es_mod
+    from orion_tpu.serving.prefix_store import overrides_fingerprint
+
+    captured = {}
+
+    class SpyStore:
+        def __init__(self, directory, identity=""):
+            captured["identity"] = identity
+
+    def spy_warm(model, store, **footprint):
+        captured["sample"] = footprint["sample"]
+        return {"n_programs": 0, "programs": [], "warmed": 0,
+                "already_warm": 0, "publish_errors": []}
+
+    monkeypatch.setattr(es_mod, "ExecStore", SpyStore)
+    monkeypatch.setattr(aot, "warm", spy_warm)
+    rc = aot.main(["warm", "--config", "tiny", "--exec-dir", str(tmp_path)])
+    assert rc == 0
+
+    ov = overrides_fingerprint({})
+    assert captured["identity"] == f"tiny:ov={ov}:seed=0|off"
+
+    fleet_defaults = build_argparser().parse_args([])
+    fleet_sample = SampleConfig(
+        fleet_defaults.temperature, fleet_defaults.top_k,
+        fleet_defaults.top_p,
+    )
+    assert sample_fingerprint(captured["sample"]) == sample_fingerprint(
+        fleet_sample
+    )
+
+
+def test_snapshot_value_reads_one_metrics_cell():
+    """obs.metrics.snapshot_value — how the cold-start bench reads a
+    child's exec counters out of its status snapshot."""
+    from orion_tpu.obs.metrics import snapshot_value
+
+    snap = {
+        "counters": [
+            {"name": "requests", "labels": {}, "value": 7},
+        ],
+        "gauges": [
+            {"name": "exec_store_events",
+             "labels": {"event": "hits"}, "value": 3},
+            {"name": "exec_store_events",
+             "labels": {"event": "fallback_compiles"}, "value": 0},
+        ],
+    }
+    assert snapshot_value(snap, "requests") == 7
+    assert snapshot_value(
+        snap, "exec_store_events", {"event": "hits"}) == 3
+    assert snapshot_value(
+        snap, "exec_store_events", {"event": "fallback_compiles"}) == 0
+    assert snapshot_value(snap, "exec_store_events") == 3  # label sum
+    assert snapshot_value(snap, "absent") is None
+
+
+# ---------------------------------------------------------------------------
+# engine-level round trips: real decode programs (slow tier)
+# ---------------------------------------------------------------------------
+
+CFG_KW = dict(
+    name="exec_test", vocab_size=64, d_model=32, n_layers=3, n_heads=2,
+    layer_types=("linear", "softmax", "swa"), window=4, max_seq_len=96,
+    dtype="float32", backend="xla",
+)
+FOOT = dict(slots=2, chunk=4, prefill_buckets=(8,), prefill_chunk=4)
+
+
+def _serve_once(model, params, exec_dir=None):
+    from orion_tpu.generate import SampleConfig
+    from orion_tpu.serving import DecodeRequest, ServeConfig, Server
+
+    cfg = ServeConfig(
+        slots=FOOT["slots"], chunk=FOOT["chunk"],
+        prefill_chunk=FOOT["prefill_chunk"], prefill_buckets="8",
+        exec_dir=exec_dir, max_inflight=4, cost=False,
+    )
+    srv = Server(model, params, cfg)
+    pend = srv.submit(DecodeRequest(
+        prompt=np.arange(1, 7, dtype=np.int32)[None, :],
+        max_new_tokens=9, sample=SampleConfig(), seed=5,
+    ))
+    srv.serve(drain_when_idle=True)
+    assert pend.result is not None and pend.result.status == "ok"
+    tokens = np.asarray(pend.result.tokens).ravel().tolist()
+    stats = (dict(srv.exec_store.stats)
+             if srv.exec_store is not None else None)
+    return tokens, stats
+
+
+@pytest.mark.slow
+def test_warm_serving_bitwise_with_zero_fallback_compiles(tmp_path):
+    """The acceptance round trip: aot.warm publishes the footprint's
+    declared universe under the server's own weights identity; a server
+    with the store then serves a sampled request BITWISE identically to
+    a storeless server, with hits and ZERO fallback compiles — and the
+    published entry count matches the declared universe exactly."""
+    from orion_tpu import aot
+    from orion_tpu.analysis.programs import expected_decode_universe
+    from orion_tpu.models.configs import ModelConfig
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.serving.prefix_store import params_identity
+
+    mcfg = ModelConfig(**CFG_KW)
+    model = TransformerLM(mcfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    exec_dir = str(tmp_path / "exec")
+    store = ExecStore(
+        exec_dir, identity=f"{params_identity(mcfg, 'off')}|off"
+    )
+    report = aot.warm(mcfg, store, **FOOT)
+    assert not report["publish_errors"]
+    universe = expected_decode_universe(
+        slots=FOOT["slots"], chunk=FOOT["chunk"],
+        prefill_buckets=FOOT["prefill_buckets"],
+        prefill_chunk=report["prefill_chunk_aligned"],
+        qmode="off", tp=0, spec_depth=0,
+    )
+    assert len(store.entries()) == len(universe) == report["n_programs"]
+    # re-warming short-circuits on content hashes: nothing recompiles
+    again = aot.warm(mcfg, store, **FOOT)
+    assert again["already_warm"] == report["n_programs"]
+    assert again["warmed"] == 0
+
+    ref_tokens, _ = _serve_once(model, params)
+    warm_tokens, stats = _serve_once(model, params, exec_dir=exec_dir)
+    assert warm_tokens == ref_tokens, "warm executables must be bitwise"
+    assert stats["fallback_compiles"] == 0
+    assert stats["hits"] > 0
+
+
+@pytest.mark.slow
+def test_corrupt_store_serves_via_jit_fallback(tmp_path):
+    """Chaos acceptance: every payload in the store truncated — the
+    engine's lookups all miss (counted), it compiles cold, and the
+    request completes bitwise-identically. A damaged store is a
+    performance event, never a correctness or availability event."""
+    import warnings as _warnings
+
+    from orion_tpu import aot
+    from orion_tpu.models.configs import ModelConfig
+    from orion_tpu.models.transformer import TransformerLM
+    from orion_tpu.serving.prefix_store import params_identity
+
+    mcfg = ModelConfig(**CFG_KW)
+    model = TransformerLM(mcfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    exec_dir = str(tmp_path / "exec")
+    store = ExecStore(
+        exec_dir, identity=f"{params_identity(mcfg, 'off')}|off"
+    )
+    aot.warm(mcfg, store, **FOOT)
+    for key in store.list_keys():
+        for gen in store.generations(key):
+            p = os.path.join(exec_dir, key, f"gen-{gen:06d}.bin")
+            with open(p, "rb") as f:
+                head = f.read(16)
+            with open(p, "wb") as f:
+                f.write(head)
+    ref_tokens, _ = _serve_once(model, params)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore", UserWarning)
+        got_tokens, stats = _serve_once(model, params, exec_dir=exec_dir)
+    assert got_tokens == ref_tokens
+    assert stats["hits"] == 0
+    assert stats["misses"] > 0 and stats["errors"] > 0
+    assert stats["fallback_compiles"] > 0, (
+        "the compile watch must count what the store failed to save"
+    )

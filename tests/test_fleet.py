@@ -24,6 +24,7 @@ import numpy as np
 import pytest
 
 from orion_tpu.fleet import (
+    AutoscalePolicy,
     LocalReplica,
     ProcessReplica,
     ReplicaHandle,
@@ -255,6 +256,152 @@ def test_replica_spawn_fault_is_retried():
     assert len(spawned) == 2 and len(sup.replicas) == 2
     # spawn ordinals keep counting across the retry (names stay unique)
     assert spawned == ["replica-0.g2", "replica-1.g3"]
+
+
+# ---------------------------------------------------------------------------
+# elastic autoscaling (ISSUE 20): hysteresis, cooldown, loss-free scale-in
+# ---------------------------------------------------------------------------
+
+
+class ScriptedReplica(FakeReplica):
+    """FakeReplica + the supervisor-facing lifecycle surface (status
+    heartbeats, drain/join/kill) so autoscaler control-loop tests drive
+    the REAL Supervisor over fully scripted signals. ``actuate`` stays
+    False in the slo section so the burn-limit healing path never buys a
+    drain-respawn — only the autoscaler reads ``firing_fast`` here."""
+
+    def __init__(self, name, **kw):
+        super().__init__(name, **kw)
+        self.last_status = None
+        self.firing_fast = []
+        self.drained = False
+        self.killed = False
+
+    def wait_ready(self, timeout=0.0):
+        return True
+
+    def status(self, timeout=0.0):
+        snap = {
+            "state": self._state, "reason": "",
+            "slo": {"firing_fast": list(self.firing_fast),
+                    "objectives": {}, "actuate": False},
+        }
+        self.last_status = snap
+        return snap
+
+    def drain(self):
+        self.drained = True
+        self._state = "draining"
+        self._alive = False
+
+    def join(self, timeout=0.0):
+        return True
+
+    def kill(self):
+        self.killed = True
+        self._alive = False
+
+
+def _scripted_fleet(n, pol):
+    made = []
+
+    def factory(name):
+        r = ScriptedReplica(name)
+        made.append(r)
+        return r
+
+    sup = Supervisor(factory, n, autoscale=pol).start()
+    return sup, made
+
+
+def test_autoscale_queue_pressure_hysteresis_and_cooldown():
+    """Queue pressure must persist up_ticks consecutive ticks before a
+    spawn; every move opens a cooldown_ticks refractory window in which
+    streaks keep accumulating but no move fires; max_replicas caps N."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3, queue_high=2.0,
+                          queue_low=1.0, up_ticks=2, down_ticks=3,
+                          cooldown_ticks=2)
+    sup, made = _scripted_fleet(1, pol)
+    made[0]._inflight = 5  # 5 >= queue_high * 1 live: pressure
+    sup.tick()  # streak 1 of 2: no move yet
+    assert len(sup.replicas) == 1
+    assert sup.autoscale_state()["queue_pressure"] is True
+    assert sup.autoscale_state()["up_streak"] == 1
+    sup.tick()  # streak 2: spawn
+    assert len(sup.replicas) == 2
+    assert any("scale_out (queue)" in e[2] for e in sup.events)
+    # pressure persists (5 >= 2.0 * 2): the cooldown must hold the loop
+    # still for exactly cooldown_ticks even as the streak accumulates
+    sup.tick()  # cooldown 2 -> 1
+    sup.tick()  # cooldown 1 -> 0
+    assert len(sup.replicas) == 2, "no move inside the refractory window"
+    sup.tick()  # cooldown over, streak >= up_ticks: second spawn
+    assert len(sup.replicas) == 3
+    # at max_replicas: pressure can streak forever, N stays put
+    made[1]._inflight = 3  # 8 >= 2.0 * 3: still pressure
+    for _ in range(6):
+        sup.tick()
+    assert sup.autoscale_state()["queue_pressure"] is True
+    assert len(sup.replicas) == 3
+    assert {r.name for r in sup.replicas} == {
+        "replica-0.g1", "replica-1.g2", "replica-2.g3",
+    }
+
+
+def test_autoscale_scale_in_drains_least_loaded_respects_min():
+    """Surplus must persist down_ticks before a drain; the victim is the
+    least-loaded replica (ties to the HIGHEST slot index), it leaves the
+    router BEFORE draining, and min_replicas is a floor."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3, queue_high=4.0,
+                          queue_low=1.0, up_ticks=2, down_ticks=2,
+                          cooldown_ticks=0)
+    sup, made = _scripted_fleet(2, pol)
+    r0, r1 = made[0], made[1]
+    r0._inflight, r1._inflight = 3, 0  # 3 > queue_low * 2: neither signal
+    sup.tick()
+    sig = sup.autoscale_state()
+    assert not sig["pressure"] and not sig["surplus"]
+    assert sig["down_streak"] == 0
+    r0._inflight = 2  # 2 <= queue_low * 2: surplus
+    sup.tick()  # streak 1 of 2
+    assert len(sup.replicas) == 2
+    sup.tick()  # streak 2: scale in
+    assert len(sup.replicas) == 1
+    # the idle replica went, the loaded one survived — and the victim
+    # was drained (sessions suspend to the shared store), not killed
+    assert sup.replicas[0] is r0
+    assert r1.drained and not r1.killed
+    assert any("scale_in; draining" in e[2] for e in sup.events)
+    # min_replicas floors the fleet: surplus streaks forever, N holds
+    r0._inflight = 0
+    for _ in range(5):
+        sup.tick()
+    assert len(sup.replicas) == 1 and not r0.drained
+
+
+def test_autoscale_burn_pressure_spawns_and_vetoes_surplus():
+    """Any replica's SLO fast-burn alert is scale-out pressure (more
+    capacity is the first response to a latency burn) and vetoes the
+    surplus signal even when the queues read idle."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2, queue_high=8.0,
+                          queue_low=4.0, up_ticks=1, down_ticks=1,
+                          cooldown_ticks=0)
+    sup, made = _scripted_fleet(1, pol)
+    made[0].firing_fast = ["latency_p99"]  # queues idle: burn alone
+    sup.tick()
+    assert len(sup.replicas) == 2
+    assert any("scale_out (burn)" in e[2] for e in sup.events)
+    sig = sup.autoscale_state()
+    assert sig["burn_pressure"] is True and sig["surplus"] is False
+    # burn still firing + queues idle enough for surplus: burn vetoes
+    # the drain (down_ticks=1 would otherwise fire instantly)
+    for _ in range(3):
+        sup.tick()
+    assert len(sup.replicas) == 2
+    # burn clears, queues idle: surplus finally wins
+    made[0].firing_fast = []
+    sup.tick()
+    assert len(sup.replicas) == 1
 
 
 # ---------------------------------------------------------------------------
